@@ -1,0 +1,23 @@
+"""mastic_tpu: a TPU-native framework for the Mastic VDAF.
+
+Scalar CPU reference layer (byte-exact against the reference
+conformance vectors):
+  common, field, keccak, aes, xof, dst, flp, vidpf, vdaf, mastic
+
+TPU execution layer (JAX / XLA / Pallas):
+  ops       batched crypto + field kernels
+  backend   level-synchronous batched VIDPF/Mastic engine
+  parallel  mesh sharding, collectives, multi-chip scaling
+  drivers   heavy hitters, attribute-based metrics, benchmarks
+"""
+
+from .mastic import (Mastic, MasticCount, MasticHistogram,
+                     MasticMultihotCountVec, MasticSum, MasticSumVec)
+from .vidpf import Vidpf
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Mastic", "MasticCount", "MasticSum", "MasticSumVec",
+    "MasticHistogram", "MasticMultihotCountVec", "Vidpf",
+]

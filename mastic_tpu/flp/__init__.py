@@ -1,0 +1,7 @@
+from .flp import FlpBBCGGI19, Gadget, Mul, ParallelSum, PolyEval, Valid
+from .circuits import Count, Histogram, MultihotCountVec, Sum, SumVec
+
+__all__ = [
+    "FlpBBCGGI19", "Gadget", "Mul", "ParallelSum", "PolyEval", "Valid",
+    "Count", "Histogram", "MultihotCountVec", "Sum", "SumVec",
+]

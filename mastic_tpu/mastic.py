@@ -1,0 +1,524 @@
+"""The Mastic VDAF: a two-party, one-round VDAF for weighted heavy
+hitters and attribute-based metrics, composing a VIDPF (input/prefix
+side) with an FLP (weight-validity side).
+
+Functionally equivalent to the reference (/root/reference/poc/mastic.py)
+and byte-exact against /root/reference/test_vec/mastic/*.json, but the
+aggregator hot path is organized around the level-synchronous prefix
+tree of mastic_tpu.vidpf so the batched TPU backend
+(mastic_tpu/backend/) can share the exact same schedule.
+"""
+
+from typing import Any, Generic, Optional, TypeAlias, TypeVar
+
+from .common import (concat, front, pack_bits, to_be_bytes, to_le_bytes,
+                     vec_add, vec_neg, vec_sub)
+from .dst import (USAGE_EVAL_PROOF, USAGE_JOINT_RAND, USAGE_JOINT_RAND_PART,
+                  USAGE_JOINT_RAND_SEED, USAGE_ONEHOT_CHECK,
+                  USAGE_PAYLOAD_CHECK, USAGE_PROOF_SHARE, USAGE_PROVE_RAND,
+                  USAGE_QUERY_RAND, dst_alg)
+from .field import F, Field64, Field128
+from .flp import (Count, FlpBBCGGI19, Histogram, MultihotCountVec, Sum,
+                  SumVec, Valid)
+from .vdaf import Vdaf
+from .vidpf import PROOF_SIZE, CorrectionWord, Path, PrefixTree, Vidpf
+from .xof import XofTurboShake128
+
+W = TypeVar("W")
+R = TypeVar("R")
+
+MasticAggParam: TypeAlias = tuple[
+    int,                  # level
+    tuple[Path, ...],     # candidate prefixes
+    bool,                 # whether to do the weight check
+]
+
+MasticInputShare: TypeAlias = tuple[
+    bytes,              # VIDPF key
+    Optional[list],     # FLP leader proof share
+    Optional[bytes],    # FLP seed
+    Optional[bytes],    # FLP peer joint rand part
+]
+
+MasticPrepState: TypeAlias = tuple[
+    list,               # truncated output share
+    Optional[bytes],    # predicted FLP joint rand seed
+]
+
+MasticPrepShare: TypeAlias = tuple[
+    bytes,              # VIDPF eval proof
+    Optional[list],     # FLP verifier share
+    Optional[bytes],    # FLP joint randomness part
+]
+
+MasticPrepMessage: TypeAlias = Optional[bytes]  # FLP joint rand seed
+
+
+class Mastic(
+        Generic[W, R, F],
+        Vdaf[
+            tuple[Path, W],          # Measurement
+            MasticAggParam,
+            list[CorrectionWord],    # PublicShare
+            MasticInputShare,
+            list,                    # OutShare
+            list,                    # AggShare
+            list,                    # AggResult
+            MasticPrepState,
+            MasticPrepShare,
+            MasticPrepMessage,
+        ]):
+
+    xof = XofTurboShake128
+
+    ID: int = 0xFFFFFFFF
+    VERIFY_KEY_SIZE = XofTurboShake128.SEED_SIZE
+    NONCE_SIZE = 16
+    SHARES = 2
+    ROUNDS = 1
+
+    test_vec_name = "Mastic"
+
+    def __init__(self, bits: int, valid: Valid[W, R, F]):
+        self.field: type[F] = valid.field
+        self.flp = FlpBBCGGI19(valid)
+        self.vidpf = Vidpf(valid.field, bits, 1 + valid.MEAS_LEN)
+        self.RAND_SIZE = self.vidpf.RAND_SIZE + 2 * self.xof.SEED_SIZE
+        if self.flp.JOINT_RAND_LEN > 0:  # FLP leader seed
+            self.RAND_SIZE += self.xof.SEED_SIZE
+
+    # -- client (reference mastic.py:91-185) -----------------------
+
+    def shard(self, ctx, measurement, nonce, rand):
+        if self.flp.JOINT_RAND_LEN > 0:
+            return self.shard_with_joint_rand(ctx, measurement, nonce, rand)
+        return self.shard_without_joint_rand(ctx, measurement, nonce, rand)
+
+    def shard_without_joint_rand(self, ctx, measurement, nonce, rand):
+        (vidpf_rand, rand) = front(self.vidpf.RAND_SIZE, rand)
+        (prove_rand_seed, rand) = front(self.xof.SEED_SIZE, rand)
+        (helper_seed, rand) = front(self.xof.SEED_SIZE, rand)
+        assert len(rand) == 0
+
+        # beta = counter || encoded weight.
+        (alpha, weight) = measurement
+        beta = [self.field(1)] + self.flp.encode(weight)
+
+        (correction_words, keys) = \
+            self.vidpf.gen(alpha, beta, ctx, nonce, vidpf_rand)
+
+        prove_rand = self.prove_rand(ctx, prove_rand_seed)
+        proof = self.flp.prove(beta[1:], prove_rand, [])
+        helper_proof_share = self.helper_proof_share(ctx, helper_seed)
+        leader_proof_share = vec_sub(proof, helper_proof_share)
+
+        input_shares: list[MasticInputShare] = [
+            (keys[0], leader_proof_share, None, None),
+            (keys[1], None, helper_seed, None),
+        ]
+        return (correction_words, input_shares)
+
+    def shard_with_joint_rand(self, ctx, measurement, nonce, rand):
+        (vidpf_rand, rand) = front(self.vidpf.RAND_SIZE, rand)
+        (prove_rand_seed, rand) = front(self.xof.SEED_SIZE, rand)
+        (helper_seed, rand) = front(self.xof.SEED_SIZE, rand)
+        (leader_seed, rand) = front(self.xof.SEED_SIZE, rand)
+        assert len(rand) == 0
+
+        (alpha, weight) = measurement
+        beta = [self.field(1)] + self.flp.encode(weight)
+
+        (correction_words, keys) = \
+            self.vidpf.gen(alpha, beta, ctx, nonce, vidpf_rand)
+
+        # Joint randomness: each party contributes a part bound to its
+        # share of beta; the client can compute both parts itself.
+        leader_beta_share = self.vidpf.get_beta_share(
+            0, correction_words, keys[0], ctx, nonce)
+        helper_beta_share = self.vidpf.get_beta_share(
+            1, correction_words, keys[1], ctx, nonce)
+        joint_rand_parts = [
+            self.joint_rand_part(ctx, leader_seed, leader_beta_share[1:],
+                                 nonce),
+            self.joint_rand_part(ctx, helper_seed, helper_beta_share[1:],
+                                 nonce),
+        ]
+        joint_rand = self.joint_rand(
+            ctx, self.joint_rand_seed(ctx, joint_rand_parts))
+
+        prove_rand = self.prove_rand(ctx, prove_rand_seed)
+        proof = self.flp.prove(beta[1:], prove_rand, joint_rand)
+        helper_proof_share = self.helper_proof_share(ctx, helper_seed)
+        leader_proof_share = vec_sub(proof, helper_proof_share)
+
+        input_shares: list[MasticInputShare] = [
+            (keys[0], leader_proof_share, leader_seed, joint_rand_parts[1]),
+            (keys[1], None, helper_seed, joint_rand_parts[0]),
+        ]
+        return (correction_words, input_shares)
+
+    # -- aggregation-parameter policy (reference mastic.py:187-203) -
+
+    def is_valid(self, agg_param, previous_agg_params):
+        (level, _prefixes, do_weight_check) = agg_param
+
+        # The weight check happens exactly once, on the first round.
+        weight_checked = \
+            (do_weight_check and len(previous_agg_params) == 0) or \
+            (not do_weight_check and
+                any(prev[2] for prev in previous_agg_params))
+
+        # The level is strictly increasing between rounds.
+        level_increased = len(previous_agg_params) == 0 or \
+            level > previous_agg_params[-1][0]
+
+        return weight_checked and level_increased
+
+    # -- aggregator (reference mastic.py:205-318) ------------------
+
+    def prep_init(self, verify_key, ctx, agg_id, agg_param, nonce,
+                  correction_words, input_share):
+        (level, prefixes, do_weight_check) = agg_param
+        (key, proof_share, seed, peer_joint_rand_part) = \
+            self.expand_input_share(ctx, agg_id, input_share)
+
+        # Evaluate the VIDPF over the level-synchronous node grid.
+        (out_share, tree) = self.vidpf.eval_level_synchronous(
+            agg_id, correction_words, key, level, prefixes, ctx, nonce)
+
+        # Weight check: query the FLP against this party's beta share.
+        joint_rand_part = None
+        joint_rand_seed = None
+        verifier_share = None
+        if do_weight_check:
+            # This party's beta share is the sum of the two depth-1
+            # payloads, both already present in the evaluated tree.
+            beta_share = vec_add(tree.levels[0][(False,)].w,
+                                 tree.levels[0][(True,)].w)
+            if agg_id == 1:
+                beta_share = vec_neg(beta_share)
+            query_rand = self.query_rand(verify_key, ctx, nonce, level)
+            joint_rand: list[F] = []
+            if self.flp.JOINT_RAND_LEN > 0:
+                assert seed is not None
+                assert peer_joint_rand_part is not None
+                joint_rand_part = self.joint_rand_part(
+                    ctx, seed, beta_share[1:], nonce)
+                if agg_id == 0:
+                    joint_rand_parts = [joint_rand_part,
+                                        peer_joint_rand_part]
+                else:
+                    joint_rand_parts = [peer_joint_rand_part,
+                                        joint_rand_part]
+                joint_rand_seed = self.joint_rand_seed(
+                    ctx, joint_rand_parts)
+                joint_rand = self.joint_rand(ctx, joint_rand_seed)
+            verifier_share = self.flp.query(
+                beta_share[1:], proof_share, query_rand, joint_rand, 2)
+
+        (payload_check_binder, onehot_check_binder) = \
+            self.check_binders(tree, level)
+
+        payload_check = self.xof(
+            b"",
+            dst_alg(ctx, USAGE_PAYLOAD_CHECK, self.ID),
+            payload_check_binder,
+        ).next(PROOF_SIZE)
+
+        onehot_check = self.xof(
+            b"",
+            dst_alg(ctx, USAGE_ONEHOT_CHECK, self.ID),
+            onehot_check_binder,
+        ).next(PROOF_SIZE)
+
+        # Counter check: beta[0] must equal 1.  Aggregator 1 adds 1 to
+        # its (negated) share so both parties derive the same bytes iff
+        # the counter is correct.
+        w0 = tree.levels[0][(False,)].w
+        w1 = tree.levels[0][(True,)].w
+        counter_check = self.field.encode_vec(
+            [w0[0] + w1[0] + self.field(agg_id)])
+
+        # A single proof binding all three checks.
+        eval_proof = self.xof(
+            verify_key,
+            dst_alg(ctx, USAGE_EVAL_PROOF, self.ID),
+            onehot_check + counter_check + payload_check,
+        ).next(PROOF_SIZE)
+
+        # Truncate each per-prefix payload to its aggregatable part.
+        truncated_out_share: list[F] = []
+        for val_share in out_share:
+            truncated_out_share += [val_share[0]] + \
+                self.flp.truncate(val_share[1:])
+
+        prep_state = (truncated_out_share, joint_rand_seed)
+        prep_share = (eval_proof, verifier_share, joint_rand_part)
+        return (prep_state, prep_share)
+
+    def check_binders(self, tree: PrefixTree[F], level: int) \
+            -> tuple[bytes, bytes]:
+        """Assemble the payload- and onehot-check binders.
+
+        The reference walks its lazily built tree breadth-first
+        (mastic.py:258-287); the equivalent order here is: per depth,
+        nodes in lexicographic path order (see vidpf.tree_schedule).
+        Every materialized node contributes its proof to the onehot
+        binder; every *internal* node (one with both children, i.e. a
+        path node) contributes `w - w_left - w_right` to the payload
+        binder.
+        """
+        payload_check_binder = b""
+        onehot_check_binder = b""
+        for (depth, nodes) in enumerate(tree.levels):
+            next_nodes = tree.levels[depth + 1] \
+                if depth + 1 < len(tree.levels) else {}
+            for (path, node) in nodes.items():
+                left = next_nodes.get(path + (False,))
+                right = next_nodes.get(path + (True,))
+                if left is not None and right is not None:
+                    payload_check_binder += self.field.encode_vec(
+                        vec_sub(node.w, vec_add(left.w, right.w)))
+                onehot_check_binder += node.proof
+        return (payload_check_binder, onehot_check_binder)
+
+    def prep_shares_to_prep(self, ctx, agg_param, prep_shares):
+        (_level, _prefixes, do_weight_check) = agg_param
+
+        if len(prep_shares) != 2:
+            raise ValueError("unexpected number of prep shares")
+
+        (eval_proof_0, verifier_share_0, joint_rand_part_0) = prep_shares[0]
+        (eval_proof_1, verifier_share_1, joint_rand_part_1) = prep_shares[1]
+
+        # VIDPF validity: both parties must derive identical proofs.
+        if eval_proof_0 != eval_proof_1:
+            raise Exception("VIDPF verification failed")
+
+        if not do_weight_check:
+            return None
+        if verifier_share_0 is None or verifier_share_1 is None:
+            raise ValueError("expected FLP verifier shares")
+
+        # FLP validity.
+        verifier = vec_add(verifier_share_0, verifier_share_1)
+        if not self.flp.decide(verifier):
+            raise Exception("FLP verification failed")
+
+        if self.flp.JOINT_RAND_LEN == 0:
+            return None
+        if joint_rand_part_0 is None or joint_rand_part_1 is None:
+            raise ValueError("expected FLP joint randomness parts")
+
+        return self.joint_rand_seed(ctx, [joint_rand_part_0,
+                                          joint_rand_part_1])
+
+    def prep_next(self, _ctx, prep_state, prep_msg):
+        (truncated_out_share, joint_rand_seed) = prep_state
+        if joint_rand_seed is not None:
+            if prep_msg is None:
+                raise ValueError("expected joint rand confirmation")
+            if prep_msg != joint_rand_seed:
+                raise Exception("joint rand confirmation failed")
+        return truncated_out_share
+
+    # -- aggregation & collection (reference mastic.py:379-411) ----
+
+    def agg_init(self, agg_param):
+        (_level, prefixes, _do_weight_check) = agg_param
+        return self.field.zeros(len(prefixes) * (1 + self.flp.OUTPUT_LEN))
+
+    def agg_update(self, agg_param, agg_share, out_share):
+        return vec_add(agg_share, out_share)
+
+    def merge(self, agg_param, agg_shares):
+        agg = self.agg_init(agg_param)
+        for agg_share in agg_shares:
+            agg = vec_add(agg, agg_share)
+        return agg
+
+    def unshard(self, agg_param, agg_shares, _num_measurements):
+        agg = self.merge(agg_param, agg_shares)
+        agg_result = []
+        while len(agg) > 0:
+            (chunk, agg) = front(1 + self.flp.OUTPUT_LEN, agg)
+            meas_count = chunk[0].int()
+            agg_result.append(self.flp.decode(chunk[1:], meas_count))
+        return agg_result
+
+    # -- wire encodings (reference mastic.py:413-435, :512-559) ----
+
+    def encode_agg_param(self, agg_param: MasticAggParam) -> bytes:
+        (level, prefixes, do_weight_check) = agg_param
+        if level not in range(2 ** 16):
+            raise ValueError("level out of range")
+        if len(prefixes) not in range(2 ** 32):
+            raise ValueError("number of prefixes out of range")
+        encoded = bytes()
+        encoded += to_be_bytes(level, 2)
+        encoded += to_be_bytes(len(prefixes), 4)
+        for prefix in prefixes:
+            encoded += pack_bits(list(prefix))
+        encoded += to_be_bytes(int(do_weight_check), 1)
+        return encoded
+
+    def decode_agg_param(self, encoded: bytes) -> MasticAggParam:
+        if len(encoded) < 7:
+            raise ValueError("malformed agg param")
+        level = int.from_bytes(encoded[:2], "big")
+        num_prefixes = int.from_bytes(encoded[2:6], "big")
+        prefix_bytes = ((level + 1) + 7) // 8
+        if len(encoded) != 6 + num_prefixes * prefix_bytes + 1:
+            raise ValueError("malformed agg param")
+        off = 6
+        prefixes = []
+        for _ in range(num_prefixes):
+            chunk = encoded[off:off + prefix_bytes]
+            prefixes.append(tuple(
+                (chunk[i // 8] >> (7 - (i % 8))) & 1 != 0
+                for i in range(level + 1)))
+            off += prefix_bytes
+        do_weight_check = bool(encoded[off])
+        return (level, tuple(prefixes), do_weight_check)
+
+    def expand_input_share(self, ctx, agg_id, input_share):
+        if agg_id == 0:
+            (key, proof_share, seed, peer_joint_rand_part) = input_share
+            assert proof_share is not None
+        else:
+            (key, _leader_share, seed, peer_joint_rand_part) = input_share
+            assert seed is not None
+            proof_share = self.helper_proof_share(ctx, seed)
+        return (key, proof_share, seed, peer_joint_rand_part)
+
+    # -- XOF derivations (reference mastic.py:452-510) -------------
+
+    def helper_proof_share(self, ctx: bytes, seed: bytes) -> list[F]:
+        return self.xof.expand_into_vec(
+            self.field, seed, dst_alg(ctx, USAGE_PROOF_SHARE, self.ID),
+            b"", self.flp.PROOF_LEN)
+
+    def prove_rand(self, ctx: bytes, seed: bytes) -> list[F]:
+        return self.xof.expand_into_vec(
+            self.field, seed, dst_alg(ctx, USAGE_PROVE_RAND, self.ID),
+            b"", self.flp.PROVE_RAND_LEN)
+
+    def joint_rand_part(self, ctx: bytes, seed: bytes,
+                        weight_share: list[F], nonce: bytes) -> bytes:
+        return self.xof.derive_seed(
+            seed, dst_alg(ctx, USAGE_JOINT_RAND_PART, self.ID),
+            nonce + self.field.encode_vec(weight_share))
+
+    def joint_rand_seed(self, ctx: bytes, parts: list[bytes]) -> bytes:
+        return self.xof.derive_seed(
+            b"", dst_alg(ctx, USAGE_JOINT_RAND_SEED, self.ID),
+            concat(parts))
+
+    def joint_rand(self, ctx: bytes, seed: bytes) -> list[F]:
+        return self.xof.expand_into_vec(
+            self.field, seed, dst_alg(ctx, USAGE_JOINT_RAND, self.ID),
+            b"", self.flp.JOINT_RAND_LEN)
+
+    def query_rand(self, verify_key: bytes, ctx: bytes, nonce: bytes,
+                   level: int) -> list[F]:
+        return self.xof.expand_into_vec(
+            self.field, verify_key, dst_alg(ctx, USAGE_QUERY_RAND, self.ID),
+            nonce + to_le_bytes(level, 2), self.flp.QUERY_RAND_LEN)
+
+    # -- test-vector encoders (reference mastic.py:512-559) --------
+
+    def test_vec_set_type_param(self, test_vec: dict[str, Any]) -> list[str]:
+        test_vec["vidpf_bits"] = int(self.vidpf.BITS)
+        return ["vidpf_bits"] + self.flp.test_vec_set_type_param(test_vec)
+
+    def test_vec_encode_input_share(self,
+                                    input_share: MasticInputShare) -> bytes:
+        (key, proof_share, seed, peer_joint_rand_part) = input_share
+        encoded = bytes()
+        encoded += key
+        if proof_share is not None:
+            encoded += self.field.encode_vec(proof_share)
+        if seed is not None:
+            encoded += seed
+        if peer_joint_rand_part is not None:
+            encoded += peer_joint_rand_part
+        return encoded
+
+    def test_vec_encode_public_share(
+            self, correction_words: list[CorrectionWord]) -> bytes:
+        return self.vidpf.encode_public_share(correction_words)
+
+    def test_vec_encode_agg_share(self, agg_share: list[F]) -> bytes:
+        encoded = bytes()
+        if len(agg_share) > 0:
+            encoded += self.field.encode_vec(agg_share)
+        return encoded
+
+    def test_vec_encode_prep_share(self,
+                                   prep_share: MasticPrepShare) -> bytes:
+        (eval_proof, verifier_share, joint_rand_part) = prep_share
+        encoded = bytes()
+        encoded += eval_proof
+        if joint_rand_part is not None:
+            encoded += joint_rand_part
+        if verifier_share is not None:
+            encoded += self.field.encode_vec(verifier_share)
+        return encoded
+
+    def test_vec_encode_prep_msg(self,
+                                 prep_message: MasticPrepMessage) -> bytes:
+        encoded = bytes()
+        if prep_message is not None:
+            encoded += prep_message
+        return encoded
+
+
+##
+# INSTANTIATIONS (reference mastic.py:567-614; IANA codepoints from
+# draft-mouris-cfrg-mastic.md:1359-1366)
+#
+
+
+class MasticCount(Mastic[int, int, Field64]):
+    ID = 0xFFFF0001
+    test_vec_name = "MasticCount"
+
+    def __init__(self, bits: int):
+        super().__init__(bits, Count(Field64))
+
+
+class MasticSum(Mastic[int, int, Field64]):
+    ID = 0xFFFF0002
+    test_vec_name = "MasticSum"
+
+    def __init__(self, bits: int, max_measurement: int):
+        super().__init__(bits, Sum(Field64, max_measurement))
+
+
+class MasticSumVec(Mastic[list[int], list[int], Field128]):
+    ID = 0xFFFF0003
+    test_vec_name = "MasticSumVec"
+
+    def __init__(self, bits: int, length: int, sum_vec_bits: int,
+                 chunk_length: int):
+        super().__init__(
+            bits, SumVec(Field128, length, sum_vec_bits, chunk_length))
+
+
+class MasticHistogram(Mastic[int, list[int], Field128]):
+    ID = 0xFFFF0004
+    test_vec_name = "MasticHistogram"
+
+    def __init__(self, bits: int, length: int, chunk_length: int):
+        super().__init__(bits, Histogram(Field128, length, chunk_length))
+
+
+class MasticMultihotCountVec(Mastic[list[bool], list[int], Field128]):
+    ID = 0xFFFF0005
+    test_vec_name = "MasticMultihotCountVec"
+
+    def __init__(self, bits: int, length: int, max_weight: int,
+                 chunk_length: int):
+        super().__init__(
+            bits, MultihotCountVec(Field128, length, max_weight,
+                                   chunk_length))

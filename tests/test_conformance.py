@@ -1,0 +1,117 @@
+"""Conformance: replay every JSON vector in
+/root/reference/test_vec/mastic/ and compare every hex field byte for
+byte (shard, prep shares, prep messages, out shares, agg shares, agg
+result).  These vectors are the cross-implementation ground truth
+(consumed also by libprio-rs; reference README.md:47-51).
+"""
+
+import json
+import os
+
+import pytest
+
+from mastic_tpu.mastic import (Mastic, MasticCount, MasticHistogram,
+                               MasticMultihotCountVec, MasticSum,
+                               MasticSumVec)
+
+TEST_VEC_DIR = os.environ.get(
+    "MASTIC_TEST_VEC", "/root/reference/test_vec/mastic")
+
+
+def _instance_for(test_vec: dict) -> Mastic:
+    bits = test_vec["vidpf_bits"]
+    name = test_vec["_name"]
+    if name.startswith("MasticCount"):
+        return MasticCount(bits)
+    if name.startswith("MasticSumVec"):
+        return MasticSumVec(bits, test_vec["length"], test_vec["bits"],
+                            test_vec["chunk_length"])
+    if name.startswith("MasticSum"):
+        return MasticSum(bits, test_vec["max_measurement"])
+    if name.startswith("MasticHistogram"):
+        return MasticHistogram(bits, test_vec["length"],
+                               test_vec["chunk_length"])
+    if name.startswith("MasticMultihotCountVec"):
+        return MasticMultihotCountVec(bits, test_vec["length"],
+                                      test_vec["max_weight"],
+                                      test_vec["chunk_length"])
+    raise ValueError(f"unknown vector {name}")
+
+
+def _parse_measurement(mastic: Mastic, raw) -> tuple:
+    (alpha_raw, weight_raw) = raw
+    alpha = tuple(bool(b) for b in alpha_raw)
+    return (alpha, weight_raw)
+
+
+def _vector_files() -> list[str]:
+    if not os.path.isdir(TEST_VEC_DIR):
+        return []
+    return sorted(f for f in os.listdir(TEST_VEC_DIR)
+                  if f.endswith(".json"))
+
+
+@pytest.mark.parametrize("filename", _vector_files())
+def test_vector(filename: str) -> None:
+    with open(os.path.join(TEST_VEC_DIR, filename)) as f:
+        test_vec = json.load(f)
+    test_vec["_name"] = filename
+    mastic = _instance_for(test_vec)
+
+    ctx = bytes.fromhex(test_vec["ctx"])
+    verify_key = bytes.fromhex(test_vec["verify_key"])
+    assert len(verify_key) == mastic.VERIFY_KEY_SIZE
+    agg_param = mastic.decode_agg_param(
+        bytes.fromhex(test_vec["agg_param"]))
+    assert mastic.encode_agg_param(agg_param).hex() == \
+        test_vec["agg_param"]
+
+    agg_shares = [mastic.agg_init(agg_param) for _ in range(2)]
+    for prep in test_vec["prep"]:
+        nonce = bytes.fromhex(prep["nonce"])
+        rand = bytes.fromhex(prep["rand"])
+        assert len(rand) == mastic.RAND_SIZE, \
+            f"RAND_SIZE {mastic.RAND_SIZE} != {len(rand)}"
+        measurement = _parse_measurement(mastic, prep["measurement"])
+
+        # Client.
+        (public_share, input_shares) = \
+            mastic.shard(ctx, measurement, nonce, rand)
+        assert mastic.test_vec_encode_public_share(public_share).hex() == \
+            prep["public_share"]
+        for (agg_id, input_share) in enumerate(input_shares):
+            assert mastic.test_vec_encode_input_share(input_share).hex() \
+                == prep["input_shares"][agg_id], f"input share {agg_id}"
+
+        # Aggregators: prep.
+        prep_states = []
+        prep_shares = []
+        for agg_id in range(2):
+            (state, share) = mastic.prep_init(
+                verify_key, ctx, agg_id, agg_param, nonce, public_share,
+                input_shares[agg_id])
+            assert mastic.test_vec_encode_prep_share(share).hex() == \
+                prep["prep_shares"][0][agg_id], f"prep share {agg_id}"
+            prep_states.append(state)
+            prep_shares.append(share)
+
+        prep_msg = mastic.prep_shares_to_prep(ctx, agg_param, prep_shares)
+        assert mastic.test_vec_encode_prep_msg(prep_msg).hex() == \
+            prep["prep_messages"][0]
+
+        for agg_id in range(2):
+            out_share = mastic.prep_next(ctx, prep_states[agg_id], prep_msg)
+            expected = [bytes.fromhex(h) for h in
+                        prep["out_shares"][agg_id]]
+            got = [mastic.field.encode_vec([x]) for x in out_share]
+            assert got == expected, f"out share {agg_id}"
+            agg_shares[agg_id] = mastic.agg_update(
+                agg_param, agg_shares[agg_id], out_share)
+
+    for agg_id in range(2):
+        assert mastic.test_vec_encode_agg_share(agg_shares[agg_id]).hex() \
+            == test_vec["agg_shares"][agg_id], f"agg share {agg_id}"
+
+    agg_result = mastic.unshard(agg_param, agg_shares,
+                                len(test_vec["prep"]))
+    assert agg_result == test_vec["agg_result"]
