@@ -18,7 +18,7 @@ verifier 3) and byte-locked by the conformance vectors.
 from typing import Generic, TypeVar
 
 from ..common import front, next_power_of_2
-from ..field import F, poly_eval, poly_interp, poly_mul
+from ..field import F, poly_add, poly_eval, poly_interp, poly_mul
 
 W = TypeVar("W")  # measurement type
 R = TypeVar("R")  # aggregate result type
@@ -98,11 +98,7 @@ class ParallelSum(Gadget[F]):
             start = i * self.subcircuit.ARITY
             term = self.subcircuit.eval_poly(
                 field, inp_poly[start:start + self.subcircuit.ARITY])
-            padded = list(term) + [field(0)] * (max(len(out), len(term))
-                                                - len(term))
-            out = [a + b for (a, b) in
-                   zip(list(out) + [field(0)] * (len(padded) - len(out)),
-                       padded)]
+            out = poly_add(field, out, term)
         return out
 
 

@@ -12,7 +12,7 @@ tree of mastic_tpu.vidpf so the batched TPU backend
 from typing import Any, Generic, Optional, TypeAlias, TypeVar
 
 from .common import (concat, front, pack_bits, to_be_bytes, to_le_bytes,
-                     vec_add, vec_neg, vec_sub)
+                     unpack_bits, vec_add, vec_neg, vec_sub)
 from .dst import (USAGE_EVAL_PROOF, USAGE_JOINT_RAND, USAGE_JOINT_RAND_PART,
                   USAGE_JOINT_RAND_SEED, USAGE_ONEHOT_CHECK,
                   USAGE_PAYLOAD_CHECK, USAGE_PROOF_SHARE, USAGE_PROVE_RAND,
@@ -217,7 +217,7 @@ class Mastic(
                 beta_share[1:], proof_share, query_rand, joint_rand, 2)
 
         (payload_check_binder, onehot_check_binder) = \
-            self.check_binders(tree, level)
+            self.check_binders(tree)
 
         payload_check = self.xof(
             b"",
@@ -256,8 +256,7 @@ class Mastic(
         prep_share = (eval_proof, verifier_share, joint_rand_part)
         return (prep_state, prep_share)
 
-    def check_binders(self, tree: PrefixTree[F], level: int) \
-            -> tuple[bytes, bytes]:
+    def check_binders(self, tree: PrefixTree[F]) -> tuple[bytes, bytes]:
         """Assemble the payload- and onehot-check binders.
 
         The reference walks its lazily built tree breadth-first
@@ -374,9 +373,7 @@ class Mastic(
         prefixes = []
         for _ in range(num_prefixes):
             chunk = encoded[off:off + prefix_bytes]
-            prefixes.append(tuple(
-                (chunk[i // 8] >> (7 - (i % 8))) & 1 != 0
-                for i in range(level + 1)))
+            prefixes.append(tuple(unpack_bits(chunk, level + 1)))
             off += prefix_bytes
         do_weight_check = bool(encoded[off])
         return (level, tuple(prefixes), do_weight_check)

@@ -1,0 +1,10 @@
+"""Batched JAX/XLA kernels for the Mastic hot path.
+
+Every kernel here is a pure, shape-static function over arrays with an
+arbitrary leading batch shape, differential-tested bit-for-bit against
+the scalar CPU reference modules in mastic_tpu/ (keccak, aes, field).
+Secret-dependent control flow never appears: all selects are lane-wise
+`jnp.where`, which is the TPU-native reading of the reference's
+constant-time implementation notes (/root/reference/poc/vidpf.py:116-119,
+:301-312).
+"""

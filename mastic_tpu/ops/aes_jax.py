@@ -1,0 +1,157 @@
+"""Batched AES-128 (encrypt-only) in JAX, bitsliced per byte.
+
+TPUs have no AES instructions and data-dependent table lookups are both
+slow (gathers) and timing-leaky, so SubBytes is computed as a boolean
+circuit over the 8 bit-planes of each byte: GF(2^8) inversion by the
+addition chain x^254 (4 multiplies + 8 squarings on bit-planes)
+followed by the affine map.  This is constant-time by construction —
+the TPU-native reading of the reference's side-channel notes
+(/root/reference/poc/vidpf.py:116-119).
+
+The circuit functions are generic over the array type (anything with
+&, ^): at import they are run on numpy over all 256 byte values and
+asserted equal to the generated S-box table of the scalar reference
+(mastic_tpu.aes.SBOX), so the JAX path and the scalar path cannot
+drift.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..aes import SBOX, _gf_mul
+
+_U8 = jnp.uint8
+
+
+def _planes(x):
+    """Split bytes into 8 bit-planes (LSB first), values 0/1."""
+    return [(x >> i) & 1 for i in range(8)]
+
+
+def _unplanes(planes):
+    out = planes[0]
+    for i in range(1, 8):
+        out = out ^ (planes[i] << i)
+    return out
+
+
+def _gf_mul_planes(a, b):
+    """Schoolbook GF(2^8) multiply on bit-planes, reduced mod 0x11B."""
+    t: list = [None] * 15
+    for i in range(8):
+        for j in range(8):
+            p = a[i] & b[j]
+            k = i + j
+            t[k] = p if t[k] is None else t[k] ^ p
+    # x^8 == x^4 + x^3 + x + 1: fold degrees 14..8 downward so cascades
+    # into still-unprocessed degrees are picked up.
+    for k in range(14, 7, -1):
+        c = t[k]
+        t[k - 4] = t[k - 4] ^ c
+        t[k - 5] = t[k - 5] ^ c
+        t[k - 7] = t[k - 7] ^ c
+        t[k - 8] = t[k - 8] ^ c
+    return t[:8]
+
+
+def _gf_square_planes(a):
+    """Squaring is linear: sum a_i x^(2i), then fold."""
+    zero = a[0] ^ a[0]
+    t = [zero] * 15
+    for i in range(8):
+        t[2 * i] = a[i]
+    for k in range(14, 7, -1):
+        c = t[k]
+        t[k - 4] = t[k - 4] ^ c
+        t[k - 5] = t[k - 5] ^ c
+        t[k - 7] = t[k - 7] ^ c
+        t[k - 8] = t[k - 8] ^ c
+    return t[:8]
+
+
+def _gf_inv_planes(x):
+    """x^254 = x^-1 (and 0 -> 0) via an addition chain."""
+    x2 = _gf_square_planes(x)
+    x3 = _gf_mul_planes(x2, x)
+    x6 = _gf_square_planes(x3)
+    x12 = _gf_square_planes(x6)
+    x15 = _gf_mul_planes(x12, x3)
+    x30 = _gf_square_planes(x15)
+    x60 = _gf_square_planes(x30)
+    x120 = _gf_square_planes(x60)
+    x240 = _gf_square_planes(x120)
+    x252 = _gf_mul_planes(x240, x12)
+    return _gf_mul_planes(x252, x2)
+
+
+def _sbox_planes(x):
+    inv = _gf_inv_planes(x)
+    out = []
+    for i in range(8):
+        bit = inv[i] ^ inv[(i + 4) % 8] ^ inv[(i + 5) % 8] \
+            ^ inv[(i + 6) % 8] ^ inv[(i + 7) % 8]
+        if (0x63 >> i) & 1:
+            bit = bit ^ 1
+        out.append(bit)
+    return out
+
+
+def sub_bytes(x):
+    """Apply the AES S-box elementwise to a uint8 array."""
+    return _unplanes(_sbox_planes(_planes(x)))
+
+
+# Lock the circuit against the table at import (numpy path).
+_check = _unplanes(_sbox_planes(_planes(np.arange(256, dtype=np.uint8))))
+assert bytes(_check) == SBOX, "bitsliced S-box circuit diverges from table"
+del _check
+
+
+def _xtime(v):
+    return ((v << 1) ^ ((v >> 7) * _U8(0x1B))).astype(_U8)
+
+
+# ShiftRows: byte i of the new state comes from byte (i + 4*(i%4)) % 16
+# (column-major state; scalar reference mastic_tpu/aes.py:97).
+_SHIFT_ROWS = tuple((i + 4 * (i % 4)) % 16 for i in range(16))
+
+_RCON = []
+_r = 1
+for _ in range(10):
+    _RCON.append(_r)
+    _r = _gf_mul(_r, 2)
+
+
+def aes128_key_schedule(keys: jax.Array) -> jax.Array:
+    """Batched key expansion: (..., 16) uint8 -> (..., 11, 16)."""
+    words = [keys[..., 4 * i:4 * i + 4] for i in range(4)]
+    for i in range(4, 44):
+        temp = words[i - 1]
+        if i % 4 == 0:
+            s = sub_bytes(temp)
+            temp = jnp.stack([
+                s[..., 1] ^ _U8(_RCON[i // 4 - 1]),
+                s[..., 2], s[..., 3], s[..., 0],
+            ], axis=-1)
+        words.append(words[i - 4] ^ temp)
+    rounds = [jnp.concatenate(words[4 * r:4 * r + 4], axis=-1)
+              for r in range(11)]
+    return jnp.stack(rounds, axis=-2)
+
+
+def aes128_encrypt(round_keys: jax.Array, blocks: jax.Array) -> jax.Array:
+    """Batched ECB encrypt: round_keys (..., 11, 16) and blocks
+    (..., 16) uint8, with broadcasting between the batch shapes."""
+    state = blocks ^ round_keys[..., 0, :]
+    for round_index in range(1, 11):
+        state = sub_bytes(state)
+        state = state[..., _SHIFT_ROWS]
+        if round_index < 10:
+            cols = state.reshape(state.shape[:-1] + (4, 4))
+            rot1 = jnp.roll(cols, -1, axis=-1)
+            mixed = _xtime(cols) ^ _xtime(rot1) ^ rot1 \
+                ^ jnp.roll(cols, -2, axis=-1) ^ jnp.roll(cols, -3, axis=-1)
+            state = mixed.reshape(state.shape)
+        state = state ^ round_keys[..., round_index, :]
+    return state

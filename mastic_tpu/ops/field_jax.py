@@ -1,0 +1,191 @@
+"""Batched Field64/Field128 arithmetic in JAX: 16-bit limbs, Montgomery
+multiplication.
+
+TPUs have no 64-bit integer lanes and no widening multiply, so field
+elements are vectors of 16-bit limbs held in uint32 (a 16x16 product
+fits in 32 bits with room for column accumulation).  Multiplication is
+schoolbook + Montgomery REDC with R = 2^(16*n); elements on device live
+in the Montgomery domain, and conversion happens only at the byte
+boundaries (XOF output -> field, field -> wire encoding), which is
+where the scalar reference (mastic_tpu.field) defines byte-exact
+behavior.
+
+Layout: shape (..., n) uint32, little-endian limb order, n = 4 for
+Field64 and n = 8 for Field128.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..field import Field, Field64, Field128
+
+_U32 = jnp.uint32
+_MASK16 = 0xFFFF
+
+
+class FieldSpec:
+    """Constants for one prime field, precomputed on the host with
+    Python bignums."""
+
+    def __init__(self, field: type[Field], gen_order: int):
+        self.field = field
+        self.modulus = field.MODULUS
+        self.encoded_size = field.ENCODED_SIZE
+        self.num_limbs = field.ENCODED_SIZE // 2
+        self.gen_order = gen_order
+        n = self.num_limbs
+        self.R = pow(2, 16 * n, self.modulus)
+        self.R2 = (self.R * self.R) % self.modulus
+        self.R_INV = pow(self.R, -1, self.modulus)
+        # -p^-1 mod 2^16, the REDC quotient constant.
+        self.P_PRIME = (-pow(self.modulus, -1, 1 << 16)) & _MASK16
+        self.P = self.int_to_limbs(self.modulus)
+        self.R2_LIMBS = self.int_to_limbs(self.R2)
+        self.ONE_MONT = self.int_to_limbs(self.R % self.modulus)
+
+    # -- host-side converters (Python bignum; for constants & tests) --
+
+    def int_to_limbs(self, value: int) -> np.ndarray:
+        return np.array([(value >> (16 * i)) & _MASK16
+                         for i in range(self.num_limbs)], np.uint32)
+
+    def limbs_to_int(self, limbs) -> int:
+        limbs = np.asarray(limbs)
+        return sum(int(limbs[..., i]) << (16 * i)
+                   for i in range(self.num_limbs))
+
+    def to_mont_host(self, value: int) -> np.ndarray:
+        return self.int_to_limbs((value * self.R) % self.modulus)
+
+    def from_mont_host(self, limbs) -> int:
+        return (self.limbs_to_int(limbs) * self.R_INV) % self.modulus
+
+    def vec_to_mont_host(self, values) -> np.ndarray:
+        """List of ints (or scalar Field elements) -> (len, n) mont limbs."""
+        out = np.zeros((len(values), self.num_limbs), np.uint32)
+        for (i, v) in enumerate(values):
+            out[i] = self.to_mont_host(v.int() if hasattr(v, "int") else v)
+        return out
+
+    def mont_to_field_host(self, limbs) -> list:
+        """(..., n) mont limbs -> flat list of scalar Field elements."""
+        arr = np.asarray(limbs).reshape(-1, self.num_limbs)
+        return [self.field(self.from_mont_host(row)) for row in arr]
+
+    # -- device ops ------------------------------------------------
+
+    def _propagate(self, cols: jax.Array, num_out: int) -> jax.Array:
+        """Carry-propagate column sums into `num_out` 16-bit limbs.
+        Column values must be < 2^32 at all times (guaranteed by the
+        callers' accumulation bounds)."""
+        limbs = []
+        carry = jnp.zeros(cols.shape[:-1], _U32)
+        for i in range(num_out):
+            v = (cols[..., i] if i < cols.shape[-1]
+                 else jnp.zeros(cols.shape[:-1], _U32)) + carry
+            limbs.append(v & _MASK16)
+            carry = v >> 16
+        return jnp.stack(limbs, axis=-1)
+
+    def _sub_limbs(self, a: jax.Array, b: np.ndarray | jax.Array):
+        """a - b limbwise with borrow chain; returns (diff, borrow)."""
+        n = a.shape[-1]
+        diff = []
+        borrow = jnp.zeros(a.shape[:-1], _U32)
+        for i in range(n):
+            bi = b[..., i] if hasattr(b, "shape") and b.ndim > 1 \
+                else _U32(int(b[i]))
+            need = bi + borrow
+            ai = a[..., i]
+            borrow = (ai < need).astype(_U32)
+            diff.append((ai + (borrow << 16) - need) & _MASK16)
+        return (jnp.stack(diff, axis=-1), borrow)
+
+    def _cond_sub_p(self, limbs: jax.Array) -> jax.Array:
+        """One conditional subtract of p (constant-time select)."""
+        p_ext = np.zeros(limbs.shape[-1], np.uint32)
+        p_ext[:self.num_limbs] = self.P
+        (diff, borrow) = self._sub_limbs(limbs, p_ext)
+        keep = (borrow == 1)[..., None]
+        return jnp.where(keep, limbs, diff)[..., :self.num_limbs]
+
+    def add(self, a: jax.Array, b: jax.Array) -> jax.Array:
+        s = self._propagate(a + b, self.num_limbs + 1)
+        return self._cond_sub_p(s)
+
+    def sub(self, a: jax.Array, b: jax.Array) -> jax.Array:
+        (diff, borrow) = self._sub_limbs(a, b)
+        plus_p = self._propagate(diff + jnp.asarray(self.P), self.num_limbs)
+        return jnp.where((borrow == 1)[..., None], plus_p, diff)
+
+    def neg(self, a: jax.Array) -> jax.Array:
+        return self.sub(jnp.zeros_like(a), a)
+
+    def mul(self, a: jax.Array, b: jax.Array) -> jax.Array:
+        """Montgomery product: mont(x)*mont(y) -> mont(x*y)."""
+        n = self.num_limbs
+        batch = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
+        # Schoolbook product into 2n+1 columns.
+        prods = a[..., :, None] * b[..., None, :]
+        lo = prods & _MASK16
+        hi = prods >> 16
+        cols = jnp.zeros(batch + (2 * n + 1,), _U32)
+        for i in range(n):
+            for j in range(n):
+                cols = cols.at[..., i + j].add(lo[..., i, j])
+                cols = cols.at[..., i + j + 1].add(hi[..., i, j])
+        t = self._propagate(cols, 2 * n + 1)
+
+        # REDC: clear the low n limbs one at a time.
+        for i in range(n):
+            m = (t[..., i] * _U32(self.P_PRIME)) & _MASK16
+            mp_lo = (m[..., None] * jnp.asarray(self.P)) & _MASK16
+            mp_hi = (m[..., None] * jnp.asarray(self.P)) >> 16
+            t = t.at[..., i:i + n].add(mp_lo)
+            t = t.at[..., i + 1:i + n + 1].add(mp_hi)
+            # Propagate the (now zero mod 2^16) limb i upward; later
+            # limbs stay bounded because each step adds < 2^17 carries.
+            t = jnp.concatenate([
+                t[..., :i],
+                self._propagate(t[..., i:], 2 * n + 1 - i),
+            ], axis=-1)
+        return self._cond_sub_p(t[..., n:])
+
+    def to_mont(self, plain: jax.Array) -> jax.Array:
+        return self.mul(plain, jnp.asarray(self.R2_LIMBS))
+
+    def from_mont(self, mont: jax.Array) -> jax.Array:
+        one = np.zeros(self.num_limbs, np.uint32)
+        one[0] = 1
+        return self.mul(mont, jnp.asarray(one))
+
+    # -- byte boundaries -------------------------------------------
+
+    def limbs_from_le_bytes(self, data: jax.Array):
+        """uint8 (..., ENCODED_SIZE) -> (plain limbs, in_range mask).
+        The mask is the XOF rejection-sampling predicate value < p
+        (scalar reference: Xof.next_vec, mastic_tpu/xof.py:33-40)."""
+        pairs = data.reshape(data.shape[:-1] + (self.num_limbs, 2))
+        limbs = pairs[..., 0].astype(_U32) | (pairs[..., 1].astype(_U32) << 8)
+        (_, borrow) = self._sub_limbs(limbs, self.P)
+        return (limbs, borrow == 1)
+
+    def mont_to_le_bytes(self, mont: jax.Array) -> jax.Array:
+        plain = self.from_mont(mont)
+        lo = (plain & 0xFF).astype(jnp.uint8)
+        hi = (plain >> 8).astype(jnp.uint8)
+        return jnp.stack([lo, hi], axis=-1).reshape(
+            mont.shape[:-1] + (self.encoded_size,))
+
+
+FIELD64 = FieldSpec(Field64, Field64.GEN_ORDER)
+FIELD128 = FieldSpec(Field128, Field128.GEN_ORDER)
+
+
+def spec_for(field: type[Field]) -> FieldSpec:
+    if field is Field64:
+        return FIELD64
+    if field is Field128:
+        return FIELD128
+    raise ValueError(f"no batched spec for {field}")

@@ -1,0 +1,158 @@
+"""Batched Keccak-p[1600] and TurboSHAKE128 in JAX.
+
+Bit-exact against the scalar reference (mastic_tpu.keccak) — the same
+round constants and rho offsets are imported from there.  Lanes are
+represented as pairs of uint32 arrays (lo, hi) with a trailing lane
+axis of size 25, because TPUs have no native 64-bit integer lane type;
+all 64-bit rotations decompose into static 32-bit shift pairs.
+
+The sponge here is *shape-static*: message length, domain byte and
+output length are Python ints, so the pad10*1 padding, the number of
+absorb permutations and the number of squeeze permutations are all
+fixed at trace time.  Data-dependent message lengths never occur in
+Mastic — every XOF call site has a length determined by (public)
+protocol parameters (reference poc/vidpf.py:366-380, poc/mastic.py:
+452-510).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..keccak import RHO_OFFSETS, ROUND_CONSTANTS
+
+RATE = 168  # TurboSHAKE128 rate in bytes (21 lanes)
+_U32 = jnp.uint32
+
+
+def _rotl64(lo: jax.Array, hi: jax.Array, n: int):
+    """Rotate the 64-bit lanes (hi||lo) left by static n."""
+    n %= 64
+    if n == 0:
+        return (lo, hi)
+    if n == 32:
+        return (hi, lo)
+    if n > 32:
+        (lo, hi) = (hi, lo)
+        n -= 32
+    m = 32 - n
+    new_lo = (lo << n) | (hi >> m)
+    new_hi = (hi << n) | (lo >> m)
+    return (new_lo, new_hi)
+
+
+def keccak_p1600(lo: jax.Array, hi: jax.Array, num_rounds: int = 12):
+    """Apply Keccak-p[1600, num_rounds] to batched lanes.
+
+    `lo`/`hi` have shape (..., 25), lane order A[x + 5*y] as in the
+    scalar reference (mastic_tpu.keccak.keccak_p1600).
+    """
+    a = [(lo[..., i], hi[..., i]) for i in range(25)]
+    for round_index in range(24 - num_rounds, 24):
+        # theta
+        c = []
+        for x in range(5):
+            clo = a[x][0] ^ a[x + 5][0] ^ a[x + 10][0] \
+                ^ a[x + 15][0] ^ a[x + 20][0]
+            chi_ = a[x][1] ^ a[x + 5][1] ^ a[x + 10][1] \
+                ^ a[x + 15][1] ^ a[x + 20][1]
+            c.append((clo, chi_))
+        d = []
+        for x in range(5):
+            (rlo, rhi) = _rotl64(*c[(x + 1) % 5], 1)
+            d.append((c[(x - 1) % 5][0] ^ rlo, c[(x - 1) % 5][1] ^ rhi))
+        a = [(a[x + 5 * y][0] ^ d[x][0], a[x + 5 * y][1] ^ d[x][1])
+             for y in range(5) for x in range(5)]
+        # rho + pi
+        b = [a[0]] * 25
+        for x in range(5):
+            for y in range(5):
+                b[y + 5 * ((2 * x + 3 * y) % 5)] = \
+                    _rotl64(*a[x + 5 * y], RHO_OFFSETS[x][y])
+        # chi
+        a = [
+            (b[x + 5 * y][0] ^ (~b[(x + 1) % 5 + 5 * y][0]
+                                & b[(x + 2) % 5 + 5 * y][0]),
+             b[x + 5 * y][1] ^ (~b[(x + 1) % 5 + 5 * y][1]
+                                & b[(x + 2) % 5 + 5 * y][1]))
+            for y in range(5) for x in range(5)
+        ]
+        # iota
+        rc = ROUND_CONSTANTS[round_index]
+        a[0] = (a[0][0] ^ _U32(rc & 0xFFFFFFFF), a[0][1] ^ _U32(rc >> 32))
+    return (jnp.stack([x[0] for x in a], axis=-1),
+            jnp.stack([x[1] for x in a], axis=-1))
+
+
+def bytes_to_lanes(data: jax.Array):
+    """uint8 (..., 8*n) -> little-endian uint32 lane halves
+    (lo, hi) of shape (..., n)."""
+    assert data.shape[-1] % 8 == 0
+    words = data.reshape(data.shape[:-1] + (-1, 2, 4)).astype(_U32)
+    shifts = _U32(1) << jnp.arange(0, 32, 8, dtype=_U32)
+    packed = jnp.sum(words * shifts, axis=-1, dtype=_U32)
+    return (packed[..., 0], packed[..., 1])
+
+
+def lanes_to_bytes(lo: jax.Array, hi: jax.Array) -> jax.Array:
+    """Inverse of bytes_to_lanes: (..., n) halves -> uint8 (..., 8*n)."""
+    packed = jnp.stack([lo, hi], axis=-1)
+    shifts = jnp.arange(0, 32, 8, dtype=_U32)
+    by = (packed[..., None] >> shifts) & _U32(0xFF)
+    return by.reshape(by.shape[:-3] + (-1,)).astype(jnp.uint8)
+
+
+def _pad_message(msg: jax.Array, domain: int) -> jax.Array:
+    """pad10*1 with the domain byte folded in (scalar reference:
+    Sponge.finalize, mastic_tpu/keccak.py:126-134)."""
+    length = msg.shape[-1]
+    num_blocks = length // RATE + 1
+    padded = jnp.zeros(msg.shape[:-1] + (num_blocks * RATE,), jnp.uint8)
+    padded = padded.at[..., :length].set(msg)
+    padded = padded.at[..., length].set(padded[..., length] ^ domain)
+    return padded.at[..., -1].set(padded[..., -1] ^ 0x80)
+
+
+def turbo_shake128(msg: jax.Array, domain: int, out_len: int,
+                   num_rounds: int = 12) -> jax.Array:
+    """Batched TurboSHAKE128(M, D, L) over uint8 messages of static
+    length: msg (..., L) -> (..., out_len)."""
+    assert 0x01 <= domain <= 0x7F
+    padded = _pad_message(msg, domain)
+    batch_shape = padded.shape[:-1]
+    num_blocks = padded.shape[-1] // RATE
+    blocks = padded.reshape(batch_shape + (num_blocks, RATE))
+    # Lane-ify: each 168-byte block is 21 lanes.
+    (mlo, mhi) = bytes_to_lanes(blocks)  # (..., num_blocks, 21)
+
+    lo = jnp.zeros(batch_shape + (25,), _U32)
+    hi = jnp.zeros(batch_shape + (25,), _U32)
+
+    if num_blocks <= 4:
+        for i in range(num_blocks):
+            lo = lo.at[..., :21].set(lo[..., :21] ^ mlo[..., i, :])
+            hi = hi.at[..., :21].set(hi[..., :21] ^ mhi[..., i, :])
+            (lo, hi) = keccak_p1600(lo, hi, num_rounds)
+    else:
+        # Long absorbs (e.g. the Mastic check binders over thousands of
+        # nodes) scan over blocks to keep the compiled program small.
+        def step(carry, xs):
+            (lo, hi) = carry
+            (blo, bhi) = xs
+            lo = lo.at[..., :21].set(lo[..., :21] ^ blo)
+            hi = hi.at[..., :21].set(hi[..., :21] ^ bhi)
+            return (keccak_p1600(lo, hi, num_rounds), None)
+
+        (blo, bhi) = (jnp.moveaxis(mlo, -2, 0), jnp.moveaxis(mhi, -2, 0))
+        ((lo, hi), _) = jax.lax.scan(step, (lo, hi), (blo, bhi))
+
+    if out_len == 0:
+        return jnp.zeros(batch_shape + (0,), jnp.uint8)
+    out = []
+    produced = 0
+    while produced < out_len:
+        if produced > 0:
+            (lo, hi) = keccak_p1600(lo, hi, num_rounds)
+        out.append(lanes_to_bytes(lo[..., :21], hi[..., :21]))
+        produced += RATE
+    full = jnp.concatenate(out, axis=-1) if len(out) > 1 else out[0]
+    return full[..., :out_len]

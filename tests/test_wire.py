@@ -1,0 +1,49 @@
+"""Wire-codec round trips and canonicality checks."""
+
+import pytest
+
+from mastic_tpu import MasticCount, MasticHistogram
+from mastic_tpu.common import gen_rand
+from mastic_tpu.field import Field64
+
+
+def test_public_share_round_trip():
+    for mastic in (MasticCount(7), MasticHistogram(3, 4, 2)):
+        vidpf = mastic.vidpf
+        alpha = vidpf.test_index_from_int(5, vidpf.BITS)
+        beta = [vidpf.field(i + 1) for i in range(vidpf.VALUE_LEN)]
+        (cw, _keys) = vidpf.gen(alpha, beta, b"ctx", gen_rand(16),
+                                gen_rand(vidpf.RAND_SIZE))
+        encoded = vidpf.encode_public_share(cw)
+        decoded = vidpf.decode_public_share(encoded)
+        assert vidpf.encode_public_share(decoded) == encoded
+        for (got, want) in zip(decoded, cw):
+            assert got[0] == want[0]
+            assert list(got[1]) == list(want[1])
+            assert got[2] == want[2]
+            assert got[3] == want[3]
+
+    with pytest.raises(ValueError):
+        MasticCount(7).vidpf.decode_public_share(encoded + b"\x00")
+
+
+def test_agg_param_round_trip_and_canonicality():
+    mastic = MasticCount(4)
+    agg_param = (1, tuple(mastic.vidpf.test_index_from_int(v, 2)
+                          for v in range(3)), True)
+    encoded = mastic.encode_agg_param(agg_param)
+    assert mastic.decode_agg_param(encoded) == agg_param
+
+    # Nonzero padding bits in a prefix chunk must be rejected: the
+    # encoding is injective on the wire (decode o encode = id).
+    tampered = bytearray(encoded)
+    tampered[6] |= 0x01  # low bit of the 2-bit prefix byte is padding
+    with pytest.raises(ValueError):
+        mastic.decode_agg_param(bytes(tampered))
+
+
+def test_agg_param_level_zero():
+    mastic = MasticCount(4)
+    agg_param = (0, ((False,), (True,)), True)
+    encoded = mastic.encode_agg_param(agg_param)
+    assert mastic.decode_agg_param(encoded) == agg_param
