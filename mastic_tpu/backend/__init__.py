@@ -1,0 +1,21 @@
+"""Level-synchronous batched execution backend (JAX / XLA).
+
+The scalar layer (mastic_tpu.vidpf / .mastic) is the byte-exact oracle;
+this package runs the same protocol math as dense arrays over a
+(reports x nodes) grid:
+
+  xof_jax     batched XofTurboShake128 / XofFixedKeyAes128
+  schedule    host-precomputed prefix-tree node grids (public data only)
+  vidpf_jax   batched VIDPF gen / eval / beta shares
+  mastic_jax  batched Mastic prep (checks, binders, eval proof)
+
+Everything secret-dependent is computed with lane-wise selects
+(jnp.where), never control flow — the TPU-native reading of the
+reference's constant-time notes (/root/reference/poc/vidpf.py:116-119,
+:300-312).
+"""
+
+from .schedule import LevelSchedule
+from .vidpf_jax import BatchedVidpf
+
+__all__ = ["LevelSchedule", "BatchedVidpf"]

@@ -1,0 +1,340 @@
+"""Batched Mastic aggregator: prep over a whole report batch at once.
+
+Device twin of the scalar Mastic.prep_init / prep_shares_to_prep /
+agg_update (mastic_tpu/mastic.py, itself byte-exact vs the reference
+/root/reference/poc/mastic.py:205-397).  Everything except the FLP
+query runs on device; the FLP query falls back to the scalar layer on
+host until the batched FLP lands (it only runs on the one weight-check
+round, reference mastic.py:187-203).
+
+Binder assembly order: the payload/onehot check binders concatenate
+per-depth node data in lexicographic order, which equals the
+reference's BFS materialization order (see backend/schedule.py).
+"""
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common import to_le_bytes
+from ..dst import (USAGE_EVAL_PROOF, USAGE_JOINT_RAND,
+                   USAGE_JOINT_RAND_PART, USAGE_JOINT_RAND_SEED,
+                   USAGE_ONEHOT_CHECK, USAGE_PAYLOAD_CHECK,
+                   USAGE_PROOF_SHARE, USAGE_QUERY_RAND, dst_alg)
+from ..mastic import Mastic
+from ..ops.field_jax import field_sum, spec_for
+from ..vidpf import PROOF_SIZE
+from .schedule import LevelSchedule
+from .vidpf_jax import BatchedCorrectionWords, BatchedVidpf, EvalState
+from .xof_jax import sample_vec, turboshake_xof
+
+SEED_SIZE = 32  # XofTurboShake128.SEED_SIZE
+
+
+class BatchedPrep(NamedTuple):
+    """Per-report device results of one aggregator's prep.
+
+    out_share    (R, P*(1+OUTPUT_LEN), n) plain limbs
+    eval_proof   (R, 32) uint8
+    beta_share   (R, VALUE_LEN, n) plain limbs (weight-check rounds)
+    query_rand   (R, QUERY_RAND_LEN, n) or None
+    joint_rand   (R, JOINT_RAND_LEN, n) or None
+    joint_rand_part / joint_rand_seed  (R, 32) uint8 or None
+    proof_share  (R, PROOF_LEN, n) plain limbs or None
+    ok           (R,) bool — False where rejection sampling fired and
+                 the scalar fallback must recompute this report
+    """
+    out_share: jax.Array
+    eval_proof: jax.Array
+    beta_share: Optional[jax.Array]
+    query_rand: Optional[jax.Array]
+    joint_rand: Optional[jax.Array]
+    joint_rand_part: Optional[jax.Array]
+    joint_rand_seed: Optional[jax.Array]
+    proof_share: Optional[jax.Array]
+    ok: jax.Array
+
+
+class BatchedMastic:
+    """Batched execution engine for one Mastic instantiation; wraps the
+    scalar instance for parameters and the host fallback paths."""
+
+    def __init__(self, mastic: Mastic):
+        self.m = mastic
+        self.spec = spec_for(mastic.field)
+        self.vidpf = BatchedVidpf(mastic.field, mastic.vidpf.BITS,
+                                  mastic.vidpf.VALUE_LEN)
+        self._trunc = self._truncate_map()
+
+    # -- truncation as a static linear map -------------------------
+
+    def _truncate_map(self):
+        """All five circuits' truncate() maps are linear (identity,
+        projection, or bit-recomposition — flp/circuits.py); express
+        them as a gather or a constant Montgomery matrix so truncation
+        runs on device."""
+        flp = self.m.flp
+        field = self.m.field
+        cols = []
+        for j in range(flp.MEAS_LEN):
+            e = field.zeros(flp.MEAS_LEN)
+            e[j] = field(1)
+            cols.append([x.int() for x in flp.truncate(e)])
+        # matrix[out][in]
+        matrix = np.array([[cols[j][o] for j in range(flp.MEAS_LEN)]
+                           for o in range(flp.OUTPUT_LEN)], object)
+        gather = np.full(flp.OUTPUT_LEN, -1, np.int64)
+        for o in range(flp.OUTPUT_LEN):
+            nonzero = [j for j in range(flp.MEAS_LEN) if matrix[o][j] != 0]
+            if len(nonzero) == 1 and matrix[o][nonzero[0]] == 1:
+                gather[o] = nonzero[0]
+            else:
+                gather[0] = -1
+                break
+        if (gather >= 0).all():
+            return ("gather", gather)
+        mont = np.zeros((flp.OUTPUT_LEN, flp.MEAS_LEN,
+                         self.spec.num_limbs), np.uint32)
+        for o in range(flp.OUTPUT_LEN):
+            for j in range(flp.MEAS_LEN):
+                mont[o, j] = self.spec.to_mont_host(int(matrix[o][j]))
+        return ("matrix", mont)
+
+    def truncate(self, w: jax.Array) -> jax.Array:
+        """Apply flp.truncate to plain-limb payloads (..., MEAS, n)."""
+        (kind, data) = self._trunc
+        if kind == "gather":
+            return w[..., data, :]
+        prods = self.spec.mul(w[..., None, :, :], jnp.asarray(data))
+        return field_sum(self.spec, prods, axis=-2)
+
+    # -- batched XOF derivations (scalar: mastic.py:393-423) -------
+
+    def _expand_vec(self, seed, usage: int, ctx: bytes, binder_parts,
+                    length: int, batch_shape):
+        dst = dst_alg(ctx, usage, self.m.ID)
+        stream = turboshake_xof(dst, seed, binder_parts,
+                                length * self.spec.encoded_size,
+                                batch_shape)
+        return sample_vec(self.spec, stream, length)
+
+    def helper_proof_share(self, ctx: bytes, seeds: jax.Array):
+        return self._expand_vec(seeds, USAGE_PROOF_SHARE, ctx, (),
+                                self.m.flp.PROOF_LEN, seeds.shape[:-1])
+
+    def query_rand(self, verify_key: bytes, ctx: bytes,
+                   nonces: jax.Array, level: int):
+        return self._expand_vec(
+            verify_key, USAGE_QUERY_RAND, ctx,
+            (nonces, to_le_bytes(level, 2)),
+            self.m.flp.QUERY_RAND_LEN, nonces.shape[:-1])
+
+    def joint_rand_part(self, ctx: bytes, seeds: jax.Array,
+                        weight_share: jax.Array, nonces: jax.Array):
+        binder = jnp.concatenate(
+            [nonces, self.spec.plain_to_le_bytes(weight_share).reshape(
+                weight_share.shape[:-2] + (-1,))], axis=-1)
+        return turboshake_xof(
+            dst_alg(ctx, USAGE_JOINT_RAND_PART, self.m.ID), seeds,
+            (binder,), SEED_SIZE, seeds.shape[:-1])
+
+    def joint_rand_seed(self, ctx: bytes, part0: jax.Array,
+                        part1: jax.Array):
+        return turboshake_xof(
+            dst_alg(ctx, USAGE_JOINT_RAND_SEED, self.m.ID), b"",
+            (part0, part1), SEED_SIZE, part0.shape[:-1])
+
+    def joint_rand(self, ctx: bytes, seeds: jax.Array):
+        return self._expand_vec(seeds, USAGE_JOINT_RAND, ctx, (),
+                                self.m.flp.JOINT_RAND_LEN,
+                                seeds.shape[:-1])
+
+    # -- the checks (scalar: mastic.py:219-247) --------------------
+
+    def check_binders(self, levels: list[EvalState],
+                      sched: LevelSchedule):
+        """Per-report payload / onehot binder byte arrays, in the BFS
+        order of the reference (mastic.py:258-287)."""
+        num_reports = levels[0].ctrl.shape[0]
+        payload_parts = []
+        for d in range(sched.level):
+            idx = sched.internal_index[d]
+            parent_w = levels[d].w[:, idx]
+            child_w = levels[d + 1].w
+            left = child_w[:, 0::2]
+            right = child_w[:, 1::2]
+            diff = self.spec.sub(parent_w,
+                                 self.spec.add(left, right))
+            payload_parts.append(
+                self.spec.plain_to_le_bytes(diff).reshape(
+                    num_reports, -1))
+        payload_binder = (
+            jnp.concatenate(payload_parts, axis=-1) if payload_parts
+            else jnp.zeros((num_reports, 0), jnp.uint8))
+        onehot_binder = jnp.concatenate(
+            [lvl.proof.reshape(num_reports, -1) for lvl in levels],
+            axis=-1)
+        return (payload_binder, onehot_binder)
+
+    def eval_proof(self, verify_key: bytes, ctx: bytes,
+                   levels: list[EvalState], sched: LevelSchedule,
+                   agg_id: int) -> jax.Array:
+        (payload_binder, onehot_binder) = self.check_binders(levels,
+                                                             sched)
+        batch = (payload_binder.shape[0],)
+        payload_check = turboshake_xof(
+            dst_alg(ctx, USAGE_PAYLOAD_CHECK, self.m.ID), b"",
+            (payload_binder,), PROOF_SIZE, batch)
+        onehot_check = turboshake_xof(
+            dst_alg(ctx, USAGE_ONEHOT_CHECK, self.m.ID), b"",
+            (onehot_binder,), PROOF_SIZE, batch)
+        # Counter check: the root children's unnegated share of beta[0],
+        # plus agg_id so both parties agree iff the counter is 1
+        # (mastic.py:234-240).
+        counter = self.spec.add(levels[0].w[:, 0, 0],
+                                levels[0].w[:, 1, 0])
+        if agg_id == 1:
+            one = np.zeros(self.spec.num_limbs, np.uint32)
+            one[0] = 1
+            counter = self.spec.add(counter, jnp.asarray(one))
+        counter_check = self.spec.plain_to_le_bytes(counter)
+        return turboshake_xof(
+            dst_alg(ctx, USAGE_EVAL_PROOF, self.m.ID), verify_key,
+            (onehot_check, counter_check, payload_check), PROOF_SIZE,
+            batch)
+
+    # -- prep (scalar: mastic.py:179-257) --------------------------
+
+    def prep(self, agg_id: int, verify_key: bytes, ctx: bytes,
+             agg_param, nonces: jax.Array, cws: BatchedCorrectionWords,
+             keys: jax.Array, proof_shares: Optional[jax.Array] = None,
+             seeds: Optional[jax.Array] = None,
+             peer_jr_parts: Optional[jax.Array] = None) -> BatchedPrep:
+        """One aggregator's prep over the report batch.
+
+        proof_shares: leader's FLP proof shares (R, PROOF_LEN, n) plain
+        limbs (agg 0, weight-check rounds); seeds: the helper's 32-byte
+        FLP seeds (agg 1); peer_jr_parts: the other party's joint-rand
+        parts (joint-rand circuits only).
+        """
+        (level, prefixes, do_weight_check) = agg_param
+        sched = LevelSchedule(prefixes, level, self.m.vidpf.BITS)
+
+        (levels, out_w, ok) = self.vidpf.eval_full(
+            agg_id, cws, keys, sched, ctx, nonces)
+
+        eval_proof = self.eval_proof(verify_key, ctx, levels, sched,
+                                     agg_id)
+
+        # Truncated out share: per prefix [counter] + truncate(weight).
+        counter = out_w[..., :1, :]
+        trunc = self.truncate(out_w[..., 1:, :])
+        out_share = jnp.concatenate([counter, trunc], axis=-2)
+        out_share = out_share.reshape(out_share.shape[0], -1,
+                                      self.spec.num_limbs)
+
+        beta_share = None
+        query_rand = None
+        joint_rand = None
+        jr_part = None
+        jr_seed = None
+        expanded_proof = proof_shares
+        if do_weight_check:
+            beta_share = self.spec.add(levels[0].w[:, 0],
+                                       levels[0].w[:, 1])
+            if agg_id == 1:
+                beta_share = self.spec.neg(beta_share)
+            (query_rand, qok) = self.query_rand(verify_key, ctx, nonces,
+                                                level)
+            ok = ok & qok
+            if agg_id == 1:
+                assert seeds is not None
+                (expanded_proof, pok) = self.helper_proof_share(ctx,
+                                                                seeds)
+                ok = ok & pok
+            if self.m.flp.JOINT_RAND_LEN > 0:
+                assert seeds is not None
+                assert peer_jr_parts is not None
+                jr_part = self.joint_rand_part(
+                    ctx, seeds, beta_share[..., 1:, :], nonces)
+                if agg_id == 0:
+                    jr_seed = self.joint_rand_seed(ctx, jr_part,
+                                                   peer_jr_parts)
+                else:
+                    jr_seed = self.joint_rand_seed(ctx, peer_jr_parts,
+                                                   jr_part)
+                (joint_rand, jok) = self.joint_rand(ctx, jr_seed)
+                ok = ok & jok
+
+        return BatchedPrep(
+            out_share=out_share, eval_proof=eval_proof,
+            beta_share=beta_share, query_rand=query_rand,
+            joint_rand=joint_rand, joint_rand_part=jr_part,
+            joint_rand_seed=jr_seed, proof_share=expanded_proof, ok=ok)
+
+    # -- FLP query host fallback (until the batched FLP lands) -----
+
+    def flp_query_host(self, prep: BatchedPrep) -> list:
+        """Per-report verifier shares via the scalar FLP."""
+        assert prep.beta_share is not None and prep.query_rand is not None
+        field = self.m.field
+        beta = np.asarray(prep.beta_share)
+        qr = np.asarray(prep.query_rand)
+        proof = np.asarray(prep.proof_share)
+        jr = (np.asarray(prep.joint_rand)
+              if prep.joint_rand is not None else None)
+        verifiers = []
+        for r in range(beta.shape[0]):
+            meas = [field(self.spec.limbs_to_int(beta[r, j]))
+                    for j in range(1, beta.shape[1])]
+            proof_share = [field(self.spec.limbs_to_int(proof[r, j]))
+                           for j in range(proof.shape[1])]
+            query_rand = [field(self.spec.limbs_to_int(qr[r, j]))
+                          for j in range(qr.shape[1])]
+            joint_rand = [] if jr is None else \
+                [field(self.spec.limbs_to_int(jr[r, j]))
+                 for j in range(jr.shape[1])]
+            verifiers.append(self.m.flp.query(
+                meas, proof_share, query_rand, joint_rand, 2))
+        return verifiers
+
+    # -- round finish (scalar: mastic.py:284-331) ------------------
+
+    def accept_mask(self, prep0: BatchedPrep, prep1: BatchedPrep,
+                    do_weight_check: bool,
+                    verifiers0=None, verifiers1=None) -> np.ndarray:
+        """Which reports pass the checks: eval proofs equal, FLP decide
+        (weight-check rounds).  Joint-rand confirmation (prep_next) is
+        seed equality, folded in here for the batched round."""
+        accept = np.array(
+            jnp.all(prep0.eval_proof == prep1.eval_proof, axis=-1))
+        if do_weight_check:
+            assert verifiers0 is not None and verifiers1 is not None
+            from ..common import vec_add
+            for r in range(len(accept)):
+                if not accept[r]:
+                    continue
+                verifier = vec_add(verifiers0[r], verifiers1[r])
+                accept[r] = self.m.flp.decide(verifier)
+        if prep0.joint_rand_seed is not None:
+            seeds_match = np.asarray(jnp.all(
+                prep0.joint_rand_seed == prep1.joint_rand_seed,
+                axis=-1))
+            accept = accept & seeds_match
+        return accept
+
+    def aggregate(self, out_share: jax.Array,
+                  accept: jax.Array) -> jax.Array:
+        """Sum accepted reports' out shares: (R, L, n) -> (L, n)."""
+        masked = jnp.where(accept[:, None, None], out_share,
+                           jnp.zeros_like(out_share))
+        return field_sum(self.spec, masked, axis=0)
+
+    # -- host boundary ---------------------------------------------
+
+    def agg_share_to_host(self, agg_share: jax.Array) -> list:
+        arr = np.asarray(agg_share)
+        return [self.m.field(self.spec.limbs_to_int(arr[i]))
+                for i in range(arr.shape[0])]

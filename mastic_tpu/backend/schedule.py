@@ -1,0 +1,90 @@
+"""Host-side precomputation of the prefix-tree evaluation grid.
+
+The candidate-prefix set and level are *public* protocol data (part of
+the aggregation parameter), identical for every report in a batch — so
+the tree shape, gather indices, node-proof binders and check ordering
+are all computed once on the host with plain Python and baked into the
+compiled program as static data.  Only seeds/payloads/proofs (secret,
+per-report) live on device.
+
+The grid reproduces the reference's breadth-first materialization
+order (/root/reference/poc/mastic.py:258-287): at each depth, children
+are generated left-then-right from lexicographically sorted parents,
+which keeps every level lexicographically sorted (see
+mastic_tpu.vidpf.tree_schedule, the scalar twin of this module).
+"""
+
+from typing import Sequence
+
+import numpy as np
+
+from ..common import to_le_bytes
+from ..vidpf import Path, encode_path
+
+
+class LevelSchedule:
+    """The dense node grid for evaluating `prefixes` at `level`.
+
+    Attributes (per depth d in 0..level, node arrays hold the children
+    at depth d+1 in lexicographic order):
+
+      num_children[d]   2 * number of distinct d-bit parent paths
+      parent_index[d]   for d>0: position of each depth-d parent in the
+                        depth d-1 child array (None at d=0: the root)
+      node_binder[d]    static node-proof binder bytes per child,
+                        uint8 (num_children[d], 4 + ceil((d+1)/8))
+      internal_index[d] for d<level: positions in child array d of the
+                        nodes whose children are materialized at d+1 —
+                        the payload-check participants, in BFS order
+      out_index         position of each requested prefix (caller's
+                        order) in the child array at depth `level`
+    """
+
+    def __init__(self, prefixes: Sequence[Path], level: int, bits: int):
+        if any(len(p) != level + 1 for p in prefixes):
+            raise ValueError("prefix with incorrect length")
+        if len(set(prefixes)) != len(prefixes):
+            raise ValueError("candidate prefixes are non-unique")
+        self.level = level
+        self.bits = bits
+        self.prefixes = tuple(prefixes)
+
+        parents: list[list[Path]] = [
+            sorted(set(p[:d] for p in prefixes)) for d in range(level + 1)
+        ]
+        children: list[list[Path]] = [
+            [par + (b,) for par in parents[d] for b in (False, True)]
+            for d in range(level + 1)
+        ]
+        child_pos: list[dict[Path, int]] = [
+            {path: i for (i, path) in enumerate(lvl)} for lvl in children
+        ]
+
+        self.num_children = [len(lvl) for lvl in children]
+        self.parent_index: list[np.ndarray | None] = [None]
+        for d in range(1, level + 1):
+            self.parent_index.append(np.array(
+                [child_pos[d - 1][par] for par in parents[d]], np.int32))
+
+        self.node_binder = []
+        for d in range(level + 1):
+            binder = np.stack([
+                np.frombuffer(
+                    to_le_bytes(bits, 2) + to_le_bytes(d, 2)
+                    + encode_path(path), np.uint8)
+                for path in children[d]
+            ])
+            self.node_binder.append(binder)
+
+        self.internal_index: list[np.ndarray] = []
+        for d in range(level):
+            self.internal_index.append(np.array(
+                [child_pos[d][par] for par in parents[d + 1]], np.int32))
+
+        self.out_index = np.array(
+            [child_pos[level][p] for p in self.prefixes], np.int32)
+
+    @property
+    def total_nodes(self) -> int:
+        """Total materialized nodes = onehot-binder length in proofs."""
+        return sum(self.num_children)

@@ -1,0 +1,349 @@
+"""Batched VIDPF: dense level-synchronous gen / eval over JAX arrays.
+
+Byte-exact twin of the scalar mastic_tpu.vidpf (itself conformance-
+locked against /root/reference/test_vec/mastic/), with the per-report
+pointer tree replaced by (reports x nodes) arrays:
+
+* one fixed-key AES key schedule per (report, usage), reused for every
+  node of that report's tree (see mastic_tpu/backend/xof_jax.py);
+* within a level, all nodes extend / correct / convert / hash in one
+  fused batch; the level loop is the only sequential axis (it is a PRG
+  chain, reference vidpf.py:250-258);
+* every secret-dependent choice is a lane select (jnp.where) — the
+  constant-time discipline the reference asks for (vidpf.py:116-119)
+  holds by construction.
+
+Field payloads are carried as plain (non-Montgomery) 16-bit limbs:
+the VIDPF only ever adds/subtracts payloads, so no domain conversion
+is needed until the FLP (which multiplies) takes over.
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common import to_le_bytes
+from ..dst import USAGE_CONVERT, USAGE_EXTEND, USAGE_NODE_PROOF, dst
+from ..field import Field
+from ..ops.field_jax import FieldSpec, spec_for
+from ..vidpf import PROOF_SIZE, CorrectionWord
+from .schedule import LevelSchedule
+from .xof_jax import (build_msg, fixed_key_blocks, fixed_key_schedule,
+                      sample_vec, turboshake_xof)
+
+_U8 = jnp.uint8
+
+KEY_SIZE = 16
+
+
+class BatchedCorrectionWords(NamedTuple):
+    """Correction words for a report batch, one slice per tree level.
+
+    seed  (R, BITS, 16) uint8
+    ctrl  (R, BITS, 2) bool       [left, right]
+    w     (R, BITS, VALUE_LEN, n) uint32 plain limbs
+    proof (R, BITS, 32) uint8
+    """
+    seed: jax.Array
+    ctrl: jax.Array
+    w: jax.Array
+    proof: jax.Array
+
+
+class EvalState(NamedTuple):
+    """One level's node states for a report batch: the resumable carry
+    of the level loop (the reference's cache-across-rounds note,
+    vidpf.py:243-245, made explicit)."""
+    seed: jax.Array   # (R, N, 16) uint8
+    ctrl: jax.Array   # (R, N) bool
+    w: jax.Array      # (R, N, VALUE_LEN, n) uint32 plain limbs
+    proof: jax.Array  # (R, N, 32) uint8
+
+
+def pack_path_bits(bits_arr: jax.Array) -> jax.Array:
+    """MSB-first bit packing of (..., L) bools -> (..., ceil(L/8))
+    uint8 (device twin of common.pack_bits)."""
+    length = bits_arr.shape[-1]
+    nbytes = (length + 7) // 8
+    padded = jnp.zeros(bits_arr.shape[:-1] + (nbytes * 8,), jnp.int32)
+    padded = padded.at[..., :length].set(bits_arr.astype(jnp.int32))
+    weights = (1 << (7 - np.arange(8))).astype(np.int32)
+    grouped = padded.reshape(padded.shape[:-1] + (nbytes, 8))
+    return jnp.sum(grouped * weights, axis=-1).astype(_U8)
+
+
+class BatchedVidpf:
+    """Batched VIDPF over `field` with input length `bits` and payload
+    length `value_len` (scalar twin: mastic_tpu.vidpf.Vidpf)."""
+
+    def __init__(self, field: type[Field], bits: int, value_len: int):
+        self.field = field
+        self.spec: FieldSpec = spec_for(field)
+        self.BITS = bits
+        self.VALUE_LEN = value_len
+        # Convert reads a 16-byte next seed then VALUE_LEN elements.
+        payload_bytes = value_len * self.spec.encoded_size
+        self.convert_blocks = 1 + (payload_bytes + 15) // 16
+
+    # -- per-report key schedules ----------------------------------
+
+    def roundkeys(self, ctx: bytes, nonces: jax.Array):
+        """The two fixed-key AES schedules per report: (extend rk,
+        convert rk), each (R, 11, 16)."""
+        batch = nonces.shape[:-1]
+        ext = fixed_key_schedule(dst(ctx, USAGE_EXTEND), nonces, batch)
+        conv = fixed_key_schedule(dst(ctx, USAGE_CONVERT), nonces, batch)
+        return (ext, conv)
+
+    # -- the three per-node primitives -----------------------------
+
+    def extend(self, ext_rk: jax.Array, seeds: jax.Array):
+        """Extend seeds (R, N..., 16) into left/right child seeds and
+        control bits (the LSB of byte 0, then cleared — reference
+        vidpf.py:330-350)."""
+        blocks = fixed_key_blocks(ext_rk, seeds, 2)
+        (s_l, s_r) = (blocks[..., :16], blocks[..., 16:])
+        t_l = (s_l[..., 0] & 1).astype(bool)
+        t_r = (s_r[..., 0] & 1).astype(bool)
+        mask = _U8(0xFE)
+        s_l = s_l.at[..., 0].set(s_l[..., 0] & mask)
+        s_r = s_r.at[..., 0].set(s_r[..., 0] & mask)
+        return ((s_l, s_r), (t_l, t_r))
+
+    def convert(self, conv_rk: jax.Array, seeds: jax.Array):
+        """Convert seeds (R, N..., 16) -> (next seed, payload limbs,
+        in-range mask per node) (reference vidpf.py:352-364)."""
+        stream = fixed_key_blocks(conv_rk, seeds, self.convert_blocks)
+        next_seed = stream[..., :16]
+        (w, ok) = sample_vec(self.spec, stream, self.VALUE_LEN, offset=16)
+        return (next_seed, w, ok)
+
+    def node_proof(self, ctx: bytes, seeds: jax.Array, binder,
+                   batch_shape: tuple) -> jax.Array:
+        """TurboSHAKE node proof over (seed, BITS, level, path); the
+        (BITS, level, path) binder is passed in pre-encoded (static for
+        eval schedules, device-packed for gen)."""
+        return turboshake_xof(dst(ctx, USAGE_NODE_PROOF), seeds,
+                              (binder,), PROOF_SIZE, batch_shape)
+
+    # -- key generation (client side; reference vidpf.py:103-211) --
+
+    def gen(self, alphas: jax.Array, betas: jax.Array, ctx: bytes,
+            nonces: jax.Array, rand: jax.Array):
+        """Batched VIDPF key generation.
+
+        alphas (R, BITS) bool; betas (R, VALUE_LEN, n) plain limbs;
+        nonces (R, 16); rand (R, 32) uint8.
+        Returns (BatchedCorrectionWords, keys (R, 2, 16), ok (R,)).
+        """
+        (num_reports, bits) = alphas.shape
+        assert bits == self.BITS
+        (ext_rk, conv_rk) = self.roundkeys(ctx, nonces)
+
+        keys = jnp.stack([rand[:, :KEY_SIZE], rand[:, KEY_SIZE:]], axis=1)
+        seed = [keys[:, 0], keys[:, 1]]
+        ctrl = [jnp.zeros(num_reports, bool), jnp.ones(num_reports, bool)]
+        ok = jnp.ones(num_reports, bool)
+
+        (cw_seed, cw_ctrl, cw_w, cw_proof) = ([], [], [], [])
+        for i in range(bits):
+            bit = alphas[:, i]
+
+            ((s0l, s0r), (t0l, t0r)) = self.extend(ext_rk, seed[0])
+            ((s1l, s1r), (t1l, t1r)) = self.extend(ext_rk, seed[1])
+
+            # The losing child's seeds are forced to collide; control
+            # corrections make on-path ctrl bits shares of 1.
+            sel = bit[:, None]
+            seed_cw = jnp.where(sel, s0l ^ s1l, s0r ^ s1r)
+            ctrl_cw_l = t0l ^ t1l ^ ~bit
+            ctrl_cw_r = t0r ^ t1r ^ bit
+
+            s0k = jnp.where(sel, s0r, s0l)
+            s1k = jnp.where(sel, s1r, s1l)
+            t0k = jnp.where(bit, t0r, t0l)
+            t1k = jnp.where(bit, t1r, t1l)
+            ctrl_cw_keep = jnp.where(bit, ctrl_cw_r, ctrl_cw_l)
+
+            s0k = jnp.where(ctrl[0][:, None], s0k ^ seed_cw, s0k)
+            t0k = t0k ^ (ctrl[0] & ctrl_cw_keep)
+            s1k = jnp.where(ctrl[1][:, None], s1k ^ seed_cw, s1k)
+            t1k = t1k ^ (ctrl[1] & ctrl_cw_keep)
+
+            (seed0, w0, ok0) = self.convert(conv_rk, s0k)
+            (seed1, w1, ok1) = self.convert(conv_rk, s1k)
+            seed = [seed0, seed1]
+            ctrl = [t0k, t1k]
+            ok = ok & ok0 & ok1
+
+            # Payload correction: on-path shares must sum to beta.
+            w_cw = self.spec.add(self.spec.sub(betas, w0), w1)
+            w_cw = jnp.where(ctrl[1][:, None, None],
+                             self.spec.neg(w_cw), w_cw)
+
+            # Node-proof correction, binding the on-path prefix.
+            binder = build_msg(
+                (num_reports,),
+                to_le_bytes(self.BITS, 2) + to_le_bytes(i, 2),
+                pack_path_bits(alphas[:, :i + 1]))
+            proof_cw = \
+                self.node_proof(ctx, seed[0], binder, (num_reports,)) ^ \
+                self.node_proof(ctx, seed[1], binder, (num_reports,))
+
+            cw_seed.append(seed_cw)
+            cw_ctrl.append(jnp.stack([ctrl_cw_l, ctrl_cw_r], axis=-1))
+            cw_w.append(w_cw)
+            cw_proof.append(proof_cw)
+
+        cws = BatchedCorrectionWords(
+            seed=jnp.stack(cw_seed, axis=1),
+            ctrl=jnp.stack(cw_ctrl, axis=1),
+            w=jnp.stack(cw_w, axis=1),
+            proof=jnp.stack(cw_proof, axis=1),
+        )
+        return (cws, keys, ok)
+
+    # -- evaluation (aggregator side; reference vidpf.py:213-325) --
+
+    def root_state(self, agg_id: int, keys: jax.Array) -> EvalState:
+        """The pre-level-0 carry: root seed = the party's key, root
+        ctrl = agg_id."""
+        num_reports = keys.shape[0]
+        return EvalState(
+            seed=keys[:, None, :],
+            ctrl=jnp.full((num_reports, 1), bool(agg_id)),
+            w=jnp.zeros((num_reports, 1, self.VALUE_LEN,
+                         self.spec.num_limbs), jnp.uint32),
+            proof=jnp.zeros((num_reports, 1, PROOF_SIZE), _U8),
+        )
+
+    def eval_step(self, ext_rk: jax.Array, conv_rk: jax.Array,
+                  parents: EvalState, cw_slice, ctx: bytes,
+                  node_binder: np.ndarray):
+        """One level of the tree: extend every parent, correct, convert
+        and hash both children.  Children are interleaved
+        (left0, right0, left1, right1, ...), preserving lexicographic
+        order.  Returns (EvalState for the children, ok (R,))."""
+        (seed_cw, ctrl_cw, w_cw, proof_cw) = cw_slice
+        (num_reports, num_parents) = parents.ctrl.shape
+
+        ((s_l, s_r), (t_l, t_r)) = self.extend(ext_rk, parents.seed)
+
+        # Correct where the parent holds the control bit.
+        sel = parents.ctrl[..., None]
+        s_l = jnp.where(sel, s_l ^ seed_cw[:, None, :], s_l)
+        s_r = jnp.where(sel, s_r ^ seed_cw[:, None, :], s_r)
+        t_l = t_l ^ (parents.ctrl & ctrl_cw[:, None, 0])
+        t_r = t_r ^ (parents.ctrl & ctrl_cw[:, None, 1])
+
+        cs = jnp.stack([s_l, s_r], axis=2).reshape(
+            num_reports, 2 * num_parents, KEY_SIZE)
+        ct = jnp.stack([t_l, t_r], axis=2).reshape(
+            num_reports, 2 * num_parents)
+
+        (next_seed, w, ok) = self.convert(conv_rk, cs)
+        w = jnp.where(ct[..., None, None],
+                      self.spec.add(w, w_cw[:, None]), w)
+
+        proof = self.node_proof(
+            ctx, next_seed, jnp.asarray(node_binder),
+            (num_reports, 2 * num_parents))
+        proof = jnp.where(ct[..., None], proof ^ proof_cw[:, None, :],
+                          proof)
+
+        child = EvalState(seed=next_seed, ctrl=ct, w=w, proof=proof)
+        return (child, jnp.all(ok, axis=-1))
+
+    def eval_full(self, agg_id: int, cws: BatchedCorrectionWords,
+                  keys: jax.Array, sched: LevelSchedule, ctx: bytes,
+                  nonces: jax.Array):
+        """Evaluate the whole grid of `sched` from the root.
+
+        Returns (levels: list[EvalState] per depth, out_w
+        (R, P, VALUE_LEN, n) payload shares in the caller's prefix
+        order (negated for aggregator 1), ok (R,)).
+        """
+        (ext_rk, conv_rk) = self.roundkeys(ctx, nonces)
+        state = self.root_state(agg_id, keys)
+        ok = jnp.ones(keys.shape[0], bool)
+        levels: list[EvalState] = []
+        for d in range(sched.level + 1):
+            pidx = sched.parent_index[d]
+            if pidx is not None:
+                state = EvalState(
+                    seed=state.seed[:, pidx], ctrl=state.ctrl[:, pidx],
+                    w=state.w[:, pidx], proof=state.proof[:, pidx])
+            cw_slice = (cws.seed[:, d], cws.ctrl[:, d], cws.w[:, d],
+                        cws.proof[:, d])
+            (state, step_ok) = self.eval_step(
+                ext_rk, conv_rk, state, cw_slice, ctx,
+                sched.node_binder[d])
+            ok = ok & step_ok
+            levels.append(state)
+
+        out_w = levels[sched.level].w[:, sched.out_index]
+        if agg_id == 1:
+            out_w = self.spec.neg(out_w)
+        return (levels, out_w, ok)
+
+    def get_beta_share(self, agg_id: int, cws: BatchedCorrectionWords,
+                       keys: jax.Array, ctx: bytes, nonces: jax.Array):
+        """Each party's beta share: sum of the two depth-1 payloads
+        (reference vidpf.py:263-279).  Returns (share, ok)."""
+        sched = LevelSchedule([(False,), (True,)], 0, self.BITS)
+        (levels, _, ok) = self.eval_full(agg_id, cws, keys, sched, ctx,
+                                         nonces)
+        share = self.spec.add(levels[0].w[:, 0], levels[0].w[:, 1])
+        if agg_id == 1:
+            share = self.spec.neg(share)
+        return (share, ok)
+
+    # -- host <-> device converters (test/wire boundary) -----------
+
+    def cws_to_host(self, cws: BatchedCorrectionWords,
+                    report: int) -> list[CorrectionWord]:
+        """One report's correction words as scalar-layer objects."""
+        out: list[CorrectionWord] = []
+        seed = np.asarray(cws.seed[report])
+        ctrl = np.asarray(cws.ctrl[report])
+        w = np.asarray(cws.w[report])
+        proof = np.asarray(cws.proof[report])
+        for d in range(self.BITS):
+            w_vec = [self.field(self.spec.limbs_to_int(w[d, j]))
+                     for j in range(self.VALUE_LEN)]
+            out.append((seed[d].tobytes(),
+                        [bool(ctrl[d, 0]), bool(ctrl[d, 1])],
+                        w_vec, proof[d].tobytes()))
+        return out
+
+    def cws_from_host(self,
+                      batches: list[list[CorrectionWord]],
+                      ) -> BatchedCorrectionWords:
+        """Scalar correction words (one list per report) -> arrays."""
+        num_reports = len(batches)
+        seed = np.zeros((num_reports, self.BITS, KEY_SIZE), np.uint8)
+        ctrl = np.zeros((num_reports, self.BITS, 2), bool)
+        w = np.zeros((num_reports, self.BITS, self.VALUE_LEN,
+                      self.spec.num_limbs), np.uint32)
+        proof = np.zeros((num_reports, self.BITS, PROOF_SIZE), np.uint8)
+        for (r, cws) in enumerate(batches):
+            for (d, (s, c, wv, p)) in enumerate(cws):
+                seed[r, d] = np.frombuffer(s, np.uint8)
+                ctrl[r, d] = c
+                for (j, el) in enumerate(wv):
+                    w[r, d, j] = self.spec.int_to_limbs(el.int())
+                proof[r, d] = np.frombuffer(p, np.uint8)
+        return BatchedCorrectionWords(
+            seed=jnp.asarray(seed), ctrl=jnp.asarray(ctrl),
+            w=jnp.asarray(w), proof=jnp.asarray(proof))
+
+    def w_to_host(self, w: jax.Array) -> list:
+        """(..., VALUE_LEN, n) plain limbs -> nested lists of scalar
+        field elements."""
+        arr = np.asarray(w)
+        if arr.ndim == 2:
+            return [self.field(self.spec.limbs_to_int(arr[j]))
+                    for j in range(arr.shape[0])]
+        return [self.w_to_host(arr[i]) for i in range(arr.shape[0])]

@@ -1,0 +1,130 @@
+"""Batched VDAF XOFs on top of the JAX crypto kernels.
+
+Mirrors the scalar constructions in mastic_tpu.xof (byte-exact):
+
+* `XofTurboShake128`: TurboSHAKE128(le16(len(dst)) || dst ||
+  le8(len(seed)) || seed || binder, domain 1).  All message lengths in
+  Mastic are static protocol parameters, so messages are built by
+  concatenating broadcast constant segments with per-lane arrays.
+
+* `XofFixedKeyAes128`: fixed key = TurboSHAKE128(le16(len(dst)) || dst
+  || binder, domain 2, 16); block i = pi(seed XOR le128(i)) with
+  pi(x) = AES(sigma(x)) XOR sigma(x), sigma(lo||hi) = hi || hi^lo.
+  One AES key schedule per (report, usage), shared across the whole
+  prefix tree — the batched kernel amortizes it over every node.
+
+Field-element sampling (`sample_vec`) reproduces the scalar rejection
+sampler *assuming no rejection* and returns the in-range mask; callers
+surface the mask so the driver can fall back to the scalar path for
+the (~2^-32 per element) lanes where a rejection would have shifted
+the stream.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common import to_le_bytes
+from ..ops.aes_jax import aes128_encrypt, aes128_key_schedule
+from ..ops.field_jax import FieldSpec
+from ..ops.keccak_jax import turbo_shake128
+
+_U8 = jnp.uint8
+
+
+def const_bytes(data: bytes) -> np.ndarray:
+    return np.frombuffer(data, dtype=np.uint8)
+
+
+def build_msg(batch_shape: tuple, *parts) -> jax.Array:
+    """Concatenate message parts along the last axis.  Parts are bytes /
+    np.uint8 constants (broadcast across the batch) or arrays with
+    leading dims broadcastable to `batch_shape`."""
+    arrs = []
+    for part in parts:
+        if isinstance(part, (bytes, bytearray)):
+            part = const_bytes(bytes(part))
+        if isinstance(part, np.ndarray):
+            part = jnp.asarray(part, _U8)
+        if part.shape[-1] == 0:
+            continue
+        arrs.append(jnp.broadcast_to(part, batch_shape + (part.shape[-1],)))
+    if not arrs:
+        return jnp.zeros(batch_shape + (0,), _U8)
+    return jnp.concatenate(arrs, axis=-1)
+
+
+def ts_prefix(dst: bytes, seed_len: int) -> bytes:
+    """The static XofTurboShake128 message prefix for a given dst and
+    seed length (scalar reference: mastic_tpu/xof.py:55-65)."""
+    return to_le_bytes(len(dst), 2) + dst + to_le_bytes(seed_len, 1)
+
+
+def turboshake_xof(dst: bytes, seed, binder_parts: tuple, out_len: int,
+                   batch_shape: tuple) -> jax.Array:
+    """Batched XofTurboShake128(seed, dst, binder).next(out_len).
+    `seed` and each binder part may be a constant bytes or an array."""
+    seed_len = len(seed) if isinstance(seed, (bytes, bytearray)) \
+        else seed.shape[-1]
+    msg = build_msg(batch_shape, ts_prefix(dst, seed_len), seed,
+                    *binder_parts)
+    return turbo_shake128(msg, 1, out_len)
+
+
+def fixed_key_schedule(dst: bytes, binder, batch_shape: tuple) -> jax.Array:
+    """Derive the per-(dst, binder) fixed AES key and expand it:
+    -> round keys (..., 11, 16)."""
+    msg = build_msg(batch_shape, to_le_bytes(len(dst), 2) + dst, binder)
+    keys = turbo_shake128(msg, 2, 16)
+    return aes128_key_schedule(keys)
+
+
+_BLOCK_INDEX_CACHE: dict[int, np.ndarray] = {}
+
+
+def _block_indices(num_blocks: int) -> np.ndarray:
+    """le128(i) for i in range(num_blocks): (num_blocks, 16) uint8."""
+    cached = _BLOCK_INDEX_CACHE.get(num_blocks)
+    if cached is None:
+        cached = np.zeros((num_blocks, 16), np.uint8)
+        for i in range(num_blocks):
+            cached[i] = const_bytes(to_le_bytes(i, 16))
+        _BLOCK_INDEX_CACHE[num_blocks] = cached
+    return cached
+
+
+def fixed_key_blocks(round_keys: jax.Array, seeds: jax.Array,
+                     num_blocks: int) -> jax.Array:
+    """XofFixedKeyAes128 output blocks 0..num_blocks-1.
+
+    round_keys: (B..., 11, 16); seeds: (B..., N..., 16) where the lead
+    dims of `seeds` start with the dims of `round_keys` (one key
+    schedule per report, many seeds per report).  Returns
+    (B..., N..., num_blocks*16) uint8.
+    """
+    x = seeds[..., None, :] ^ jnp.asarray(_block_indices(num_blocks))
+    lo = x[..., :8]
+    hi = x[..., 8:]
+    sigma = jnp.concatenate([hi, hi ^ lo], axis=-1)
+    # Broadcast round keys across the per-report seed dims + block dim.
+    extra = sigma.ndim - round_keys.ndim + 1
+    rk = round_keys.reshape(
+        round_keys.shape[:-2] + (1,) * extra + round_keys.shape[-2:])
+    out = aes128_encrypt(rk, sigma) ^ sigma
+    return out.reshape(out.shape[:-2] + (num_blocks * 16,))
+
+
+def sample_vec(spec: FieldSpec, stream: jax.Array, length: int,
+               offset: int = 0) -> tuple[jax.Array, jax.Array]:
+    """Read `length` field elements from XOF output bytes starting at
+    `offset`: -> (plain limbs (..., length, n), in_range (...)).
+
+    Byte-exact vs the scalar rejection sampler when no rejection
+    occurs; the returned mask is False for lanes where any element fell
+    outside the field (caller falls back to the scalar path there).
+    """
+    size = spec.encoded_size
+    data = stream[..., offset:offset + length * size]
+    data = data.reshape(data.shape[:-1] + (length, size))
+    (limbs, ok) = spec.limbs_from_le_bytes(data)
+    return (limbs, jnp.all(ok, axis=-1))

@@ -172,11 +172,33 @@ class FieldSpec:
         return (limbs, borrow == 1)
 
     def mont_to_le_bytes(self, mont: jax.Array) -> jax.Array:
-        plain = self.from_mont(mont)
+        return self.plain_to_le_bytes(self.from_mont(mont))
+
+    def plain_to_le_bytes(self, plain: jax.Array) -> jax.Array:
+        """Canonical little-endian wire encoding of plain-domain limbs
+        (byte-exact vs the scalar field.encode_vec)."""
         lo = (plain & 0xFF).astype(jnp.uint8)
         hi = (plain >> 8).astype(jnp.uint8)
         return jnp.stack([lo, hi], axis=-1).reshape(
-            mont.shape[:-1] + (self.encoded_size,))
+            plain.shape[:-1] + (self.encoded_size,))
+
+
+def field_sum(spec: FieldSpec, x: jax.Array, axis: int) -> jax.Array:
+    """Exact modular sum along `axis` by pairwise tree reduction
+    (log2(n) full-width adds; used for share aggregation, reference
+    mastic.py:384-397)."""
+    x = jnp.moveaxis(x, axis, 0)
+    n = x.shape[0]
+    if n == 0:
+        raise ValueError("empty field sum")
+    while n > 1:
+        half = n // 2
+        rest = x[2 * half:]
+        x = spec.add(x[:half], x[half:2 * half])
+        if rest.shape[0]:
+            x = jnp.concatenate([x, rest], axis=0)
+        n = x.shape[0]
+    return x[0]
 
 
 FIELD64 = FieldSpec(Field64, Field64.GEN_ORDER)

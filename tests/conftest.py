@@ -1,17 +1,42 @@
 """Test configuration.
 
 Sharding/mesh tests run on a virtual 8-device CPU mesh; the real-TPU
-benchmark path is exercised separately by bench.py.  The env vars must
-be set before jax initializes its backends, hence here.
+benchmark path is exercised separately by bench.py.  All env vars must
+be set before `import jax` (jax snapshots them into config defaults at
+import time), hence the ordering below.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU: the ambient environment pins jax to the real TPU tunnel
+# (its sitecustomize overrides the jax_platforms *config*, so the env
+# var alone is not enough — see the config.update below), and tests
+# must not depend on the tunnel — it blocks for minutes when down.
+# The virtual 8-device CPU mesh is the test fabric for all sharding
+# paths.
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = \
         (xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# Persistent compilation cache.  The CPU backend in this jax build does
+# not serialize executables (the cache stays empty under pytest), but
+# the same config is what bench.py relies on for the real TPU chip,
+# where first compiles are the dominant startup cost.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      "/tmp/mastic_tpu_jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+
+import jax  # noqa: E402  (after the env setup above, by design)
+
+jax.config.update("jax_platforms", "cpu")
+# This jax build does not pick the cache dir up from the env var, so
+# set the config explicitly (CPU cache needs the min-size/-time floors
+# dropped, done via the env vars above).
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ["JAX_COMPILATION_CACHE_DIR"])
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

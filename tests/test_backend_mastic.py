@@ -1,0 +1,182 @@
+"""Differential tests: batched Mastic prep vs the scalar protocol.
+
+Runs the full one-round aggregation (shard on the scalar layer, prep
+on the batched backend, checks + aggregation + unshard) and requires
+byte-equality with the scalar path at every boundary.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from mastic_tpu import (MasticCount, MasticHistogram,
+                        MasticMultihotCountVec, MasticSum, MasticSumVec)
+from mastic_tpu.backend.mastic_jax import BatchedMastic
+from mastic_tpu.common import vec_add
+
+CTX = b"batched mastic test"
+VERIFY_KEY = bytes(range(32))
+
+
+def _limbs(spec, vec):
+    return np.stack([spec.int_to_limbs(x.int()) for x in vec])
+
+
+def _run_round(mastic, measurements, agg_param, seed=0):
+    rng = np.random.default_rng(seed)
+    bm = BatchedMastic(mastic)
+    spec = bm.spec
+    (level, prefixes, do_weight_check) = agg_param
+
+    reports = []
+    for meas in measurements:
+        nonce = rng.integers(0, 256, 16, dtype=np.uint8).tobytes()
+        rand = rng.integers(0, 256, mastic.RAND_SIZE,
+                            dtype=np.uint8).tobytes()
+        (public_share, input_shares) = mastic.shard(CTX, meas, nonce,
+                                                    rand)
+        reports.append((nonce, public_share, input_shares))
+
+    # Host -> device marshalling.
+    nonces = jnp.asarray(np.stack(
+        [np.frombuffer(n, np.uint8) for (n, _, _) in reports]))
+    cws = bm.vidpf.cws_from_host([ps for (_, ps, _) in reports])
+    keys = [
+        jnp.asarray(np.stack([np.frombuffer(sh[agg_id][0], np.uint8)
+                              for (_, _, sh) in reports]))
+        for agg_id in range(2)
+    ]
+    leader_proofs = jnp.asarray(np.stack(
+        [_limbs(spec, sh[0][1]) for (_, _, sh) in reports]))
+    helper_seeds = jnp.asarray(np.stack(
+        [np.frombuffer(sh[1][2], np.uint8) for (_, _, sh) in reports]))
+    if mastic.flp.JOINT_RAND_LEN > 0:
+        leader_seeds = jnp.asarray(np.stack(
+            [np.frombuffer(sh[0][2], np.uint8) for (_, _, sh) in reports]))
+        peer_parts = [
+            jnp.asarray(np.stack(
+                [np.frombuffer(sh[agg_id][3], np.uint8)
+                 for (_, _, sh) in reports]))
+            for agg_id in range(2)
+        ]
+        seeds = [leader_seeds, helper_seeds]
+    else:
+        peer_parts = [None, None]
+        seeds = [None, helper_seeds]
+
+    preps = [
+        bm.prep(0, VERIFY_KEY, CTX, agg_param, nonces, cws, keys[0],
+                proof_shares=leader_proofs, seeds=seeds[0],
+                peer_jr_parts=peer_parts[0]),
+        bm.prep(1, VERIFY_KEY, CTX, agg_param, nonces, cws, keys[1],
+                seeds=seeds[1], peer_jr_parts=peer_parts[1]),
+    ]
+    assert bool(np.all(np.asarray(preps[0].ok)))
+    assert bool(np.all(np.asarray(preps[1].ok)))
+
+    # Scalar oracle: the full protocol per report.
+    for (r, (nonce, public_share, input_shares)) in enumerate(reports):
+        states = []
+        shares = []
+        for agg_id in range(2):
+            (state, share) = mastic.prep_init(
+                VERIFY_KEY, CTX, agg_id, agg_param, nonce, public_share,
+                input_shares[agg_id])
+            states.append(state)
+            shares.append(share)
+        (eval_proof_ref, verifier_ref, jr_part_ref) = shares[0]
+        p = preps[0]
+        assert np.asarray(p.eval_proof[r]).tobytes() == eval_proof_ref
+        assert np.asarray(
+            preps[1].eval_proof[r]).tobytes() == shares[1][0]
+        if jr_part_ref is not None:
+            assert np.asarray(
+                p.joint_rand_part[r]).tobytes() == jr_part_ref
+            assert np.asarray(
+                preps[1].joint_rand_part[r]).tobytes() == shares[1][2]
+        prep_msg = mastic.prep_shares_to_prep(CTX, agg_param, shares)
+        for agg_id in range(2):
+            out_ref = mastic.prep_next(CTX, states[agg_id], prep_msg)
+            got = np.asarray(preps[agg_id].out_share[r])
+            assert [bm.spec.limbs_to_int(got[i])
+                    for i in range(got.shape[0])] == \
+                [x.int() for x in out_ref], f"out share {agg_id} {r}"
+
+    # Batched verifier shares + accept + aggregate + unshard.
+    if do_weight_check:
+        verifiers = [bm.flp_query_host(p) for p in preps]
+        # Cross-check one verifier pair against the scalar decide.
+        assert mastic.flp.decide(vec_add(verifiers[0][0],
+                                         verifiers[1][0]))
+    else:
+        verifiers = [None, None]
+    accept = bm.accept_mask(preps[0], preps[1], do_weight_check,
+                            verifiers[0], verifiers[1])
+    assert accept.all()
+    agg_shares = [
+        bm.agg_share_to_host(
+            bm.aggregate(p.out_share, jnp.asarray(accept)))
+        for p in preps
+    ]
+    return mastic.unshard(agg_param, agg_shares, len(measurements))
+
+
+def _path(mastic, value):
+    return mastic.vidpf.test_index_from_int(value, mastic.vidpf.BITS)
+
+
+def _all_prefixes(mastic, level):
+    return tuple(mastic.vidpf.test_index_from_int(v, level + 1)
+                 for v in range(2 ** (level + 1)))
+
+
+def test_count():
+    mastic = MasticCount(2)
+    measurements = [(_path(mastic, 0b10), 1), (_path(mastic, 0b11), 1),
+                    (_path(mastic, 0b10), 0)]
+    prefixes = _all_prefixes(mastic, 1)
+    result = _run_round(mastic, measurements, (1, prefixes, True))
+    assert result == [0, 0, 1, 1]
+
+
+def test_count_no_weight_check():
+    mastic = MasticCount(3)
+    measurements = [(_path(mastic, 0b101), 1), (_path(mastic, 0b100), 1)]
+    prefixes = _all_prefixes(mastic, 2)
+    result = _run_round(mastic, measurements, (2, prefixes, False))
+    assert result == [0, 0, 0, 0, 1, 1, 0, 0]
+
+
+def test_sum():
+    mastic = MasticSum(2, 7)
+    measurements = [(_path(mastic, 0b00), 3), (_path(mastic, 0b01), 5),
+                    (_path(mastic, 0b00), 7)]
+    prefixes = ((False,), (True,))
+    result = _run_round(mastic, measurements, (0, prefixes, True))
+    assert result == [15, 0]
+
+
+def test_sum_vec():
+    mastic = MasticSumVec(2, 2, 2, 1)
+    measurements = [(_path(mastic, 0b10), [1, 2]),
+                    (_path(mastic, 0b10), [3, 1])]
+    prefixes = _all_prefixes(mastic, 1)
+    result = _run_round(mastic, measurements, (1, prefixes, True))
+    assert result == [[0, 0], [0, 0], [4, 3], [0, 0]]
+
+
+def test_histogram():
+    mastic = MasticHistogram(2, 3, 2)
+    measurements = [(_path(mastic, 0b01), 0), (_path(mastic, 0b01), 2)]
+    prefixes = ((False, True),)
+    result = _run_round(mastic, measurements, (1, prefixes, True))
+    assert result == [[1, 0, 1]]
+
+
+def test_multihot():
+    mastic = MasticMultihotCountVec(2, 3, 2, 2)
+    measurements = [(_path(mastic, 0b11), [True, False, True]),
+                    (_path(mastic, 0b11), [False, False, True])]
+    prefixes = _all_prefixes(mastic, 0)
+    result = _run_round(mastic, measurements, (0, prefixes, True))
+    assert result == [[0, 0, 0], [1, 0, 2]]

@@ -1,0 +1,144 @@
+"""Differential tests: batched JAX VIDPF vs the scalar oracle.
+
+The scalar layer is conformance-locked against the reference vectors,
+so byte-equality here extends that lock to the batched backend.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from mastic_tpu.backend import BatchedVidpf, LevelSchedule
+from mastic_tpu.backend.vidpf_jax import pack_path_bits
+from mastic_tpu.common import pack_bits
+from mastic_tpu.field import Field64, Field128
+from mastic_tpu.vidpf import Vidpf
+
+CTX = b"backend differential test"
+
+
+def _rand_u8(rng, shape):
+    return rng.integers(0, 256, shape, dtype=np.uint8)
+
+
+def _setup(field, bits, value_len, num_reports, seed):
+    rng = np.random.default_rng(seed)
+    scalar = Vidpf(field, bits, value_len)
+    batched = BatchedVidpf(field, bits, value_len)
+    alphas = rng.integers(0, 2, (num_reports, bits)).astype(bool)
+    betas_scalar = [
+        [field(int(x)) for x in rng.integers(0, 1000, value_len)]
+        for _ in range(num_reports)
+    ]
+    nonces = _rand_u8(rng, (num_reports, 16))
+    rand = _rand_u8(rng, (num_reports, 32))
+    return (scalar, batched, alphas, betas_scalar, nonces, rand)
+
+
+def _batched_gen(batched, alphas, betas_scalar, nonces, rand):
+    betas = np.stack([
+        np.stack([batched.spec.int_to_limbs(x.int()) for x in beta])
+        for beta in betas_scalar
+    ])
+    return batched.gen(jnp.asarray(alphas), jnp.asarray(betas), CTX,
+                       jnp.asarray(nonces), jnp.asarray(rand))
+
+
+@pytest.mark.parametrize("field,bits,value_len",
+                         [(Field64, 4, 2), (Field128, 3, 3)])
+def test_gen_matches_scalar(field, bits, value_len):
+    (scalar, batched, alphas, betas_scalar, nonces, rand) = _setup(
+        field, bits, value_len, num_reports=3, seed=7)
+    (cws, keys, ok) = _batched_gen(batched, alphas, betas_scalar, nonces,
+                                   rand)
+    assert bool(np.all(ok))
+
+    for r in range(alphas.shape[0]):
+        alpha = tuple(bool(b) for b in alphas[r])
+        (cws_ref, keys_ref) = scalar.gen(
+            alpha, betas_scalar[r], CTX, nonces[r].tobytes(),
+            rand[r].tobytes())
+        assert np.asarray(keys[r, 0]).tobytes() == keys_ref[0]
+        assert np.asarray(keys[r, 1]).tobytes() == keys_ref[1]
+        got = batched.cws_to_host(cws, r)
+        for (d, (g, e)) in enumerate(zip(got, cws_ref)):
+            assert g[0] == e[0], f"seed cw, report {r} level {d}"
+            assert g[1] == e[1], f"ctrl cw, report {r} level {d}"
+            assert [x.int() for x in g[2]] == [x.int() for x in e[2]], \
+                f"payload cw, report {r} level {d}"
+            assert g[3] == e[3], f"proof cw, report {r} level {d}"
+
+
+@pytest.mark.parametrize("field,bits,value_len,level",
+                         [(Field64, 4, 2, 2), (Field64, 4, 2, 3),
+                          (Field128, 3, 3, 1)])
+def test_eval_matches_scalar(field, bits, value_len, level):
+    (scalar, batched, alphas, betas_scalar, nonces, rand) = _setup(
+        field, bits, value_len, num_reports=2, seed=11)
+    (cws, keys, _) = _batched_gen(batched, alphas, betas_scalar, nonces,
+                                  rand)
+
+    # A prefix set mixing on-path and off-path nodes, deliberately not
+    # in sorted order (the out gather must follow the caller's order).
+    all_prefixes = scalar.prefixes_for_level(level)
+    prefixes = list(all_prefixes[::-1][:3])
+    sched = LevelSchedule(prefixes, level, bits)
+
+    for agg_id in range(2):
+        (levels, out_w, ok) = batched.eval_full(
+            agg_id, cws, keys[:, agg_id], sched, CTX, jnp.asarray(nonces))
+        assert bool(np.all(ok))
+
+        for r in range(alphas.shape[0]):
+            cws_ref = batched.cws_to_host(cws, r)
+            key = np.asarray(keys[r, agg_id]).tobytes()
+            (out_ref, tree_ref) = scalar.eval_level_synchronous(
+                agg_id, cws_ref, key, level, prefixes, CTX,
+                nonces[r].tobytes())
+            # Per-prefix output shares (incl. aggregator-1 negation).
+            got_out = batched.w_to_host(out_w[r])
+            for (p, (g, e)) in enumerate(zip(got_out, out_ref)):
+                assert [x.int() for x in g] == [x.int() for x in e], \
+                    f"out share agg {agg_id} report {r} prefix {p}"
+            # Every materialized node: seed, ctrl, payload, proof.
+            for (d, nodes_ref) in enumerate(tree_ref.levels):
+                paths = sorted(nodes_ref)
+                st = levels[d]
+                for (j, path) in enumerate(paths):
+                    node = nodes_ref[path]
+                    assert np.asarray(
+                        st.seed[r, j]).tobytes() == node.seed
+                    assert bool(st.ctrl[r, j]) == node.ctrl
+                    got_w = batched.w_to_host(st.w[r, j])
+                    assert [x.int() for x in got_w] == \
+                        [x.int() for x in node.w]
+                    assert np.asarray(
+                        st.proof[r, j]).tobytes() == node.proof
+
+
+def test_beta_share_matches_scalar():
+    (field, bits, value_len) = (Field64, 3, 4)
+    (scalar, batched, alphas, betas_scalar, nonces, rand) = _setup(
+        field, bits, value_len, num_reports=2, seed=13)
+    (cws, keys, _) = _batched_gen(batched, alphas, betas_scalar, nonces,
+                                  rand)
+    for agg_id in range(2):
+        (share, ok) = batched.get_beta_share(
+            agg_id, cws, keys[:, agg_id], CTX, jnp.asarray(nonces))
+        assert bool(np.all(ok))
+        for r in range(alphas.shape[0]):
+            cws_ref = batched.cws_to_host(cws, r)
+            key = np.asarray(keys[r, agg_id]).tobytes()
+            expect = scalar.get_beta_share(agg_id, cws_ref, key, CTX,
+                                           nonces[r].tobytes())
+            got = batched.w_to_host(share[r])
+            assert [x.int() for x in got] == [x.int() for x in expect]
+
+
+def test_pack_path_bits_matches_host():
+    rng = np.random.default_rng(3)
+    for length in (1, 5, 8, 13, 16):
+        bits = rng.integers(0, 2, (4, length)).astype(bool)
+        got = np.asarray(pack_path_bits(jnp.asarray(bits)))
+        for r in range(4):
+            assert got[r].tobytes() == pack_bits(list(bits[r]))

@@ -23,8 +23,8 @@ def test_public_share_round_trip():
             assert got[2] == want[2]
             assert got[3] == want[3]
 
-    with pytest.raises(ValueError):
-        MasticCount(7).vidpf.decode_public_share(encoded + b"\x00")
+        with pytest.raises(ValueError):
+            vidpf.decode_public_share(encoded + b"\x00")
 
 
 def test_agg_param_round_trip_and_canonicality():
