@@ -1,0 +1,171 @@
+"""Benchmark: steady-state VIDPF evaluation throughput on one chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The metric is the BASELINE.json north star — VIDPF node evaluations
+per second per chip at 256-bit tree depth, where one node evaluation
+is the full extend + correct + convert + node-proof pipeline of
+/root/reference/poc/vidpf.py:281-325 (2 fixed-key-AES blocks + 2 AES
+convert blocks + 1 TurboSHAKE-128 hash per node, reference op model in
+BASELINE.md).  The reference publishes no timing numbers, so
+vs_baseline compares against this repo's own scalar CPU reference
+layer (the same byte-exact math the reference's Python PoC runs),
+measured in-process.
+
+Shapes mimic the heavy-hitters steady state: a pruned frontier of
+constant width marching down a 256-level tree; each timed step is one
+tree level over (reports x frontier) with a traced node binder so a
+single compiled program serves every level.
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+
+def _watchdog(seconds: float):
+    """Emit a failure JSON line and hard-exit if the chip never comes
+    up (the remote-TPU tunnel can block indefinitely)."""
+
+    def fire():
+        print(json.dumps({
+            "metric": "vidpf_node_evals_per_sec_per_chip_256bit",
+            "value": 0.0, "unit": "evals/s",
+            "vs_baseline": 0.0, "error": "watchdog timeout",
+        }), flush=True)
+        os._exit(2)
+
+    timer = threading.Timer(seconds, fire)
+    timer.daemon = True
+    timer.start()
+    return timer
+
+
+def scalar_rate(bits: int = 256, level: int = 3) -> float:
+    """Node evals/sec of the scalar byte-exact reference layer."""
+    from mastic_tpu.field import Field64
+    from mastic_tpu.vidpf import Vidpf
+
+    vidpf = Vidpf(Field64, bits, 2)
+    alpha = tuple(bool(i % 2) for i in range(bits))
+    beta = [Field64(1), Field64(1)]
+    nonce = bytes(16)
+    rand = bytes(range(32))
+    (cws, keys) = vidpf.gen(alpha, beta, b"bench", nonce, rand)
+    prefixes = tuple(
+        tuple(bool((v >> (level - i)) & 1) for i in range(level + 1))
+        for v in range(2 ** (level + 1)))
+    t0 = time.perf_counter()
+    (_, tree) = vidpf.eval_level_synchronous(
+        0, cws, keys[0], level, prefixes, b"bench", nonce)
+    dt = time.perf_counter() - t0
+    nodes = sum(len(lvl) for lvl in tree.levels)
+    return nodes / dt
+
+
+def batched_rate(reports: int, frontier: int, steps: int,
+                 bits: int = 256) -> float:
+    """Steady-state node evals/sec of the batched backend on the
+    default chip."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from mastic_tpu import MasticCount
+    from mastic_tpu.backend.mastic_jax import BatchedMastic
+    from mastic_tpu.backend.vidpf_jax import EvalState
+
+    bm = BatchedMastic(MasticCount(bits))
+    vid = bm.vidpf
+    ctx = b"bench"
+    rng = np.random.default_rng(0)
+
+    nonces = jnp.asarray(rng.integers(0, 256, (reports, 16),
+                                      dtype=np.uint8))
+    roundkeys = jax.jit(lambda n: vid.roundkeys(ctx, n))
+    (ext_rk, conv_rk) = roundkeys(nonces)
+
+    # One level's inputs; binder is traced so one compile serves all
+    # levels (at depth >= 248 the path encoding is 32 bytes).
+    def mk_state(num_nodes):
+        return EvalState(
+            seed=jnp.asarray(rng.integers(
+                0, 256, (reports, num_nodes, 16), dtype=np.uint8)),
+            ctrl=jnp.asarray(rng.integers(
+                0, 2, (reports, num_nodes)).astype(bool)),
+            w=jnp.zeros((reports, num_nodes, 2, 4), jnp.uint32),
+            proof=jnp.zeros((reports, num_nodes, 32), jnp.uint8),
+        )
+
+    cw = (
+        jnp.asarray(rng.integers(0, 256, (reports, 16), np.uint8)),
+        jnp.asarray(rng.integers(0, 2, (reports, 2)).astype(bool)),
+        jnp.asarray(rng.integers(0, 1 << 16, (reports, 2, 4),
+                                 dtype=np.uint32)),
+        jnp.asarray(rng.integers(0, 256, (reports, 32), np.uint8)),
+    )
+    binder = jnp.asarray(rng.integers(0, 256, (2 * frontier, 36),
+                                      dtype=np.uint8))
+    keep = np.arange(0, 2 * frontier, 2)
+
+    @jax.jit
+    def step(seed, ctrl, binder):
+        parents = EvalState(seed=seed, ctrl=ctrl,
+                            w=jnp.zeros_like(state.w),
+                            proof=jnp.zeros_like(state.proof))
+        (child, ok) = vid.eval_step(ext_rk, conv_rk, parents, cw, ctx,
+                                    binder)
+        # Prune back to the frontier width (threshold survivors).
+        return (child.seed[:, keep], child.ctrl[:, keep],
+                child.proof, ok)
+
+    state = mk_state(frontier)
+    (seed, ctrl) = (state.seed, state.ctrl)
+    # Warmup / compile.
+    (seed, ctrl, _, _) = step(seed, ctrl, binder)
+    jax.block_until_ready(seed)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        (seed, ctrl, proof, ok) = step(seed, ctrl, binder)
+    jax.block_until_ready(seed)
+    dt = time.perf_counter() - t0
+    return reports * 2 * frontier * steps / dt
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--reports", type=int, default=4096)
+    parser.add_argument("--frontier", type=int, default=64)
+    parser.add_argument("--steps", type=int, default=16)
+    parser.add_argument("--cpu", action="store_true",
+                        help="force the CPU backend (local sanity)")
+    parser.add_argument("--watchdog", type=float, default=900.0)
+    args = parser.parse_args()
+
+    timer = _watchdog(args.watchdog)
+    if args.cpu:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    requested = os.environ.get("JAX_PLATFORMS", "").strip()
+    if requested and "axon" not in requested.split(","):
+        jax.config.update("jax_platforms", requested)
+
+    base = scalar_rate()
+    rate = batched_rate(args.reports, args.frontier, args.steps)
+    timer.cancel()
+    print(json.dumps({
+        "metric": "vidpf_node_evals_per_sec_per_chip_256bit",
+        "value": round(rate, 1),
+        "unit": "evals/s",
+        "vs_baseline": round(rate / base, 1),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

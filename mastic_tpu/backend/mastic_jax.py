@@ -57,6 +57,18 @@ class BatchedPrep(NamedTuple):
     ok: jax.Array
 
 
+class ReportBatch(NamedTuple):
+    """A report batch marshalled to device arrays (host boundary of
+    the upload channel; wire formats in mastic_tpu.mastic)."""
+    nonces: jax.Array              # (R, 16) uint8
+    cws: BatchedCorrectionWords
+    keys: jax.Array                # (R, 2, 16) uint8
+    leader_proofs: jax.Array       # (R, PROOF_LEN, n) plain limbs
+    helper_seeds: jax.Array        # (R, 32) uint8
+    leader_seeds: Optional[jax.Array]   # (R, 32) or None
+    peer_parts: tuple              # per agg: (R, 32) or None
+
+
 class BatchedMastic:
     """Batched execution engine for one Mastic instantiation; wraps the
     scalar instance for parameters and the host fallback paths."""
@@ -338,3 +350,55 @@ class BatchedMastic:
         arr = np.asarray(agg_share)
         return [self.m.field(self.spec.limbs_to_int(arr[i]))
                 for i in range(arr.shape[0])]
+
+    def marshal_reports(self, reports: list) -> ReportBatch:
+        """Scalar-layer reports [(nonce, public_share, input_shares)]
+        -> device arrays (the aggregator's upload ingestion path)."""
+        nonces = np.stack([np.frombuffer(n, np.uint8)
+                           for (n, _, _) in reports])
+        cws = self.vidpf.cws_from_host([ps for (_, ps, _) in reports])
+        keys = np.stack([
+            np.stack([np.frombuffer(sh[a][0], np.uint8)
+                      for a in range(2)])
+            for (_, _, sh) in reports
+        ])
+        leader_proofs = np.stack([
+            np.stack([self.spec.int_to_limbs(x.int())
+                      for x in sh[0][1]])
+            for (_, _, sh) in reports
+        ])
+        helper_seeds = np.stack([np.frombuffer(sh[1][2], np.uint8)
+                                 for (_, _, sh) in reports])
+        if self.m.flp.JOINT_RAND_LEN > 0:
+            leader_seeds = jnp.asarray(np.stack(
+                [np.frombuffer(sh[0][2], np.uint8)
+                 for (_, _, sh) in reports]))
+            peer_parts = tuple(
+                jnp.asarray(np.stack(
+                    [np.frombuffer(sh[a][3], np.uint8)
+                     for (_, _, sh) in reports]))
+                for a in range(2))
+        else:
+            leader_seeds = None
+            peer_parts = (None, None)
+        return ReportBatch(
+            nonces=jnp.asarray(nonces), cws=cws,
+            keys=jnp.asarray(keys),
+            leader_proofs=jnp.asarray(leader_proofs),
+            helper_seeds=jnp.asarray(helper_seeds),
+            leader_seeds=leader_seeds, peer_parts=peer_parts)
+
+    def prep_both(self, verify_key: bytes, ctx: bytes, agg_param,
+                  batch: ReportBatch) -> tuple:
+        """Run both aggregators' prep on a marshalled batch (the
+        in-process protocol simulation, reference examples.py:51-59)."""
+        p0 = self.prep(0, verify_key, ctx, agg_param, batch.nonces,
+                       batch.cws, batch.keys[:, 0],
+                       proof_shares=batch.leader_proofs,
+                       seeds=batch.leader_seeds,
+                       peer_jr_parts=batch.peer_parts[0])
+        p1 = self.prep(1, verify_key, ctx, agg_param, batch.nonces,
+                       batch.cws, batch.keys[:, 1],
+                       seeds=batch.helper_seeds,
+                       peer_jr_parts=batch.peer_parts[1])
+        return (p0, p1)

@@ -86,6 +86,10 @@ class BatchedVidpf:
         # Convert reads a 16-byte next seed then VALUE_LEN elements.
         payload_bytes = value_len * self.spec.encoded_size
         self.convert_blocks = 1 + (payload_bytes + 15) // 16
+        # Optional mesh-sharding hook: applied to every level's
+        # EvalState so the (reports x nodes) grid stays distributed
+        # (set by mastic_tpu.parallel.mesh).
+        self.constrain_state = None
 
     # -- per-report key schedules ----------------------------------
 
@@ -254,6 +258,8 @@ class BatchedVidpf:
                           proof)
 
         child = EvalState(seed=next_seed, ctrl=ct, w=w, proof=proof)
+        if self.constrain_state is not None:
+            child = self.constrain_state(child)
         return (child, jnp.all(ok, axis=-1))
 
     def eval_full(self, agg_id: int, cws: BatchedCorrectionWords,

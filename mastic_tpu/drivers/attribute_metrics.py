@@ -1,0 +1,47 @@
+"""Attribute-based metrics mode: a single aggregation at the last
+level with hashed attributes as the index space.
+
+Functionally equivalent to the reference
+(/root/reference/poc/examples.py:172-260; spec mode
+draft-mouris-cfrg-mastic.md:1574-1611): alpha = H(attribute) truncated
+to BITS, one weight-checked aggregation at level BITS-1 with the
+candidate prefixes being the collector's attributes of interest.
+"""
+
+import hashlib
+from typing import Optional, Sequence
+
+from ..common import gen_rand
+from ..mastic import Mastic
+from ..backend.mastic_jax import BatchedMastic
+from .heavy_hitters import run_round
+
+
+def hash_attribute(mastic: Mastic, attribute: str) -> tuple:
+    """SHA3-256 the attribute and keep the first BITS bits (the
+    reference truncates the same way for BITS=8; collision resistance
+    governs how small BITS may be in practice)."""
+    bits = mastic.vidpf.BITS
+    digest = hashlib.sha3_256(attribute.encode()).digest()
+    value = int.from_bytes(digest[:(bits + 7) // 8], "big")
+    value >>= (8 - bits % 8) % 8
+    return mastic.vidpf.test_index_from_int(value, bits)
+
+
+def aggregate_by_attribute(mastic: Mastic, ctx: bytes,
+                           attributes: Sequence[str], reports: list,
+                           verify_key: Optional[bytes] = None) -> list:
+    """Aggregate `reports` grouped by the collector's attributes of
+    interest.  Returns [(attribute, aggregate)] pairs."""
+    if verify_key is None:
+        verify_key = gen_rand(mastic.VERIFY_KEY_SIZE)
+    bm = BatchedMastic(mastic)
+    batch = bm.marshal_reports(reports)
+    level = mastic.vidpf.BITS - 1
+    prefixes = tuple(hash_attribute(mastic, a) for a in attributes)
+    if len(set(prefixes)) != len(prefixes):
+        raise ValueError("attribute hash collision; increase BITS")
+    agg_param = (level, prefixes, True)
+    assert mastic.is_valid(agg_param, [])
+    result = run_round(bm, verify_key, ctx, agg_param, batch)
+    return list(zip(attributes, result))

@@ -1,0 +1,52 @@
+"""Communication-cost report (the reference's overhead example,
+/root/reference/poc/examples.py:263-364, rebuilt on this framework's
+codecs).
+
+Reports this framework's *measured* wire sizes by encoding real
+reports for the same configs the reference benchmarks, plus the
+protocol-shape facts the spec itself states (1 prep round vs
+Poplar1's 2; O(num_measurements x BITS) inter-aggregator traffic,
+draft-mouris-cfrg-mastic.md:166-168, :1619-1623).  The Poplar1 /
+Prio3 implementations themselves are out of the framework's scope
+(SURVEY.md §2.2), and their byte counts are not archived in
+BASELINE.md, so no numbers are invented for them here.
+"""
+
+from ..common import gen_rand
+from ..mastic import Mastic, MasticCount, MasticHistogram, MasticSum
+
+
+def report_sizes(mastic: Mastic, measurement) -> dict:
+    """Encode one report and measure each wire message."""
+    ctx = b"sizes"
+    nonce = gen_rand(mastic.NONCE_SIZE)
+    rand = gen_rand(mastic.RAND_SIZE)
+    (public_share, input_shares) = mastic.shard(ctx, measurement, nonce,
+                                                rand)
+    public = len(mastic.test_vec_encode_public_share(public_share))
+    leader = len(mastic.test_vec_encode_input_share(input_shares[0]))
+    helper = len(mastic.test_vec_encode_input_share(input_shares[1]))
+    return {
+        "public_share": public,
+        "leader_share": leader,
+        "helper_share": helper,
+        "upload": public + leader + helper,
+    }
+
+
+def communication_report(print_fn=print) -> dict:
+    """Mastic upload sizes for the reference's comparison configs."""
+    out = {}
+    alpha256 = (False,) * 256
+
+    out["MasticCount(256)"] = report_sizes(MasticCount(256),
+                                           (alpha256, 1))
+    out["MasticSum(256, max=255)"] = report_sizes(
+        MasticSum(256, 255), (alpha256, 17))
+    out["MasticHistogram(32, 100, 10)"] = report_sizes(
+        MasticHistogram(32, 100, 10), ((False,) * 32, 3))
+    out["prep_rounds"] = {"mastic": 1, "poplar1_spec": 2}
+
+    for (name, sizes) in out.items():
+        print_fn(f"{name}: {sizes}")
+    return out

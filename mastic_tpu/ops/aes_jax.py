@@ -124,34 +124,55 @@ for _ in range(10):
 
 
 def aes128_key_schedule(keys: jax.Array) -> jax.Array:
-    """Batched key expansion: (..., 16) uint8 -> (..., 11, 16)."""
-    words = [keys[..., 4 * i:4 * i + 4] for i in range(4)]
-    for i in range(4, 44):
-        temp = words[i - 1]
-        if i % 4 == 0:
-            s = sub_bytes(temp)
-            temp = jnp.stack([
-                s[..., 1] ^ _U8(_RCON[i // 4 - 1]),
-                s[..., 2], s[..., 3], s[..., 0],
-            ], axis=-1)
-        words.append(words[i - 4] ^ temp)
-    rounds = [jnp.concatenate(words[4 * r:4 * r + 4], axis=-1)
-              for r in range(11)]
-    return jnp.stack(rounds, axis=-2)
+    """Batched key expansion: (..., 16) uint8 -> (..., 11, 16).
+
+    The 10 expansion rounds run under lax.scan — each round contains a
+    full bitsliced S-box circuit, and unrolling all of them dominated
+    XLA compile time."""
+    words = keys.reshape(keys.shape[:-1] + (4, 4))
+
+    def body(words, rcon):
+        s = sub_bytes(words[..., 3, :])
+        temp = jnp.stack([s[..., 1] ^ rcon, s[..., 2], s[..., 3],
+                          s[..., 0]], axis=-1)
+        w0 = words[..., 0, :] ^ temp
+        w1 = words[..., 1, :] ^ w0
+        w2 = words[..., 2, :] ^ w1
+        w3 = words[..., 3, :] ^ w2
+        new = jnp.stack([w0, w1, w2, w3], axis=-2)
+        return (new, new)
+
+    (_, rounds) = jax.lax.scan(body, words,
+                               jnp.asarray(_RCON, dtype=_U8))
+    rounds = jnp.moveaxis(rounds, 0, -3)  # (..., 10, 4, 4)
+    all_rounds = jnp.concatenate([words[..., None, :, :], rounds],
+                                 axis=-3)
+    return all_rounds.reshape(keys.shape[:-1] + (11, 16))
+
+
+def _sub_shift(state: jax.Array) -> jax.Array:
+    return sub_bytes(state)[..., _SHIFT_ROWS]
+
+
+def _mix_columns(state: jax.Array) -> jax.Array:
+    cols = state.reshape(state.shape[:-1] + (4, 4))
+    rot1 = jnp.roll(cols, -1, axis=-1)
+    mixed = _xtime(cols) ^ _xtime(rot1) ^ rot1 \
+        ^ jnp.roll(cols, -2, axis=-1) ^ jnp.roll(cols, -3, axis=-1)
+    return mixed.reshape(state.shape)
 
 
 def aes128_encrypt(round_keys: jax.Array, blocks: jax.Array) -> jax.Array:
     """Batched ECB encrypt: round_keys (..., 11, 16) and blocks
-    (..., 16) uint8, with broadcasting between the batch shapes."""
+    (..., 16) uint8, with broadcasting between the batch shapes.
+    Middle rounds run under lax.scan (one S-box circuit compiled, not
+    nine)."""
     state = blocks ^ round_keys[..., 0, :]
-    for round_index in range(1, 11):
-        state = sub_bytes(state)
-        state = state[..., _SHIFT_ROWS]
-        if round_index < 10:
-            cols = state.reshape(state.shape[:-1] + (4, 4))
-            rot1 = jnp.roll(cols, -1, axis=-1)
-            mixed = _xtime(cols) ^ _xtime(rot1) ^ rot1 \
-                ^ jnp.roll(cols, -2, axis=-1) ^ jnp.roll(cols, -3, axis=-1)
-            state = mixed.reshape(state.shape)
-        state = state ^ round_keys[..., round_index, :]
-    return state
+    mid = jnp.moveaxis(round_keys[..., 1:10, :], -2, 0)
+    mid = jnp.broadcast_to(mid, (9,) + state.shape)
+
+    def body(state, rk):
+        return (_mix_columns(_sub_shift(state)) ^ rk, None)
+
+    (state, _) = jax.lax.scan(body, state, mid)
+    return _sub_shift(state) ^ round_keys[..., 10, :]

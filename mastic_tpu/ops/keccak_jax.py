@@ -40,47 +40,67 @@ def _rotl64(lo: jax.Array, hi: jax.Array, n: int):
     return (new_lo, new_hi)
 
 
+def _keccak_round(lo: jax.Array, hi: jax.Array, rc_lo: jax.Array,
+                  rc_hi: jax.Array):
+    """One Keccak-p round on (..., 25) lane halves."""
+    a = [(lo[..., i], hi[..., i]) for i in range(25)]
+    # theta
+    c = []
+    for x in range(5):
+        clo = a[x][0] ^ a[x + 5][0] ^ a[x + 10][0] \
+            ^ a[x + 15][0] ^ a[x + 20][0]
+        chi_ = a[x][1] ^ a[x + 5][1] ^ a[x + 10][1] \
+            ^ a[x + 15][1] ^ a[x + 20][1]
+        c.append((clo, chi_))
+    d = []
+    for x in range(5):
+        (rlo, rhi) = _rotl64(*c[(x + 1) % 5], 1)
+        d.append((c[(x - 1) % 5][0] ^ rlo, c[(x - 1) % 5][1] ^ rhi))
+    a = [(a[x + 5 * y][0] ^ d[x][0], a[x + 5 * y][1] ^ d[x][1])
+         for y in range(5) for x in range(5)]
+    # rho + pi
+    b = [a[0]] * 25
+    for x in range(5):
+        for y in range(5):
+            b[y + 5 * ((2 * x + 3 * y) % 5)] = \
+                _rotl64(*a[x + 5 * y], RHO_OFFSETS[x][y])
+    # chi
+    a = [
+        (b[x + 5 * y][0] ^ (~b[(x + 1) % 5 + 5 * y][0]
+                            & b[(x + 2) % 5 + 5 * y][0]),
+         b[x + 5 * y][1] ^ (~b[(x + 1) % 5 + 5 * y][1]
+                            & b[(x + 2) % 5 + 5 * y][1]))
+        for y in range(5) for x in range(5)
+    ]
+    # iota
+    a[0] = (a[0][0] ^ rc_lo, a[0][1] ^ rc_hi)
+    return (jnp.stack([x[0] for x in a], axis=-1),
+            jnp.stack([x[1] for x in a], axis=-1))
+
+
+_RC_LO = jnp.asarray([rc & 0xFFFFFFFF for rc in ROUND_CONSTANTS], _U32)
+_RC_HI = jnp.asarray([rc >> 32 for rc in ROUND_CONSTANTS], _U32)
+
+
 def keccak_p1600(lo: jax.Array, hi: jax.Array, num_rounds: int = 12):
     """Apply Keccak-p[1600, num_rounds] to batched lanes.
 
     `lo`/`hi` have shape (..., 25), lane order A[x + 5*y] as in the
-    scalar reference (mastic_tpu.keccak.keccak_p1600).
+    scalar reference (mastic_tpu.keccak.keccak_p1600).  Rounds run
+    under lax.scan so the round body compiles once — the permutation
+    is called at every tree node and the unrolled form dominated XLA
+    compile time.
     """
-    a = [(lo[..., i], hi[..., i]) for i in range(25)]
-    for round_index in range(24 - num_rounds, 24):
-        # theta
-        c = []
-        for x in range(5):
-            clo = a[x][0] ^ a[x + 5][0] ^ a[x + 10][0] \
-                ^ a[x + 15][0] ^ a[x + 20][0]
-            chi_ = a[x][1] ^ a[x + 5][1] ^ a[x + 10][1] \
-                ^ a[x + 15][1] ^ a[x + 20][1]
-            c.append((clo, chi_))
-        d = []
-        for x in range(5):
-            (rlo, rhi) = _rotl64(*c[(x + 1) % 5], 1)
-            d.append((c[(x - 1) % 5][0] ^ rlo, c[(x - 1) % 5][1] ^ rhi))
-        a = [(a[x + 5 * y][0] ^ d[x][0], a[x + 5 * y][1] ^ d[x][1])
-             for y in range(5) for x in range(5)]
-        # rho + pi
-        b = [a[0]] * 25
-        for x in range(5):
-            for y in range(5):
-                b[y + 5 * ((2 * x + 3 * y) % 5)] = \
-                    _rotl64(*a[x + 5 * y], RHO_OFFSETS[x][y])
-        # chi
-        a = [
-            (b[x + 5 * y][0] ^ (~b[(x + 1) % 5 + 5 * y][0]
-                                & b[(x + 2) % 5 + 5 * y][0]),
-             b[x + 5 * y][1] ^ (~b[(x + 1) % 5 + 5 * y][1]
-                                & b[(x + 2) % 5 + 5 * y][1]))
-            for y in range(5) for x in range(5)
-        ]
-        # iota
-        rc = ROUND_CONSTANTS[round_index]
-        a[0] = (a[0][0] ^ _U32(rc & 0xFFFFFFFF), a[0][1] ^ _U32(rc >> 32))
-    return (jnp.stack([x[0] for x in a], axis=-1),
-            jnp.stack([x[1] for x in a], axis=-1))
+
+    def body(carry, rcs):
+        (lo, hi) = carry
+        (rc_lo, rc_hi) = rcs
+        return (_keccak_round(lo, hi, rc_lo, rc_hi), None)
+
+    start = 24 - num_rounds
+    ((lo, hi), _) = jax.lax.scan(
+        body, (lo, hi), (_RC_LO[start:], _RC_HI[start:]))
+    return (lo, hi)
 
 
 def bytes_to_lanes(data: jax.Array):

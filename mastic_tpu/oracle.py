@@ -1,0 +1,35 @@
+"""Functional model of Mastic: what the protocol computes, with no
+crypto.  Differential-testing oracle for the drivers (the reference
+ships the same kind of model at talks/func.py).
+"""
+
+from typing import Sequence
+
+
+def prefix_weights(measurements: Sequence[tuple], prefixes: Sequence[tuple],
+                   zero, add):
+    """Total weight per candidate prefix: sum of beta over measurements
+    whose alpha has that prefix.  `zero`/`add` abstract the weight
+    monoid (ints, vectors, ...)."""
+    out = {p: zero() for p in prefixes}
+    for (alpha, beta) in measurements:
+        for p in prefixes:
+            if tuple(alpha[:len(p)]) == tuple(p):
+                out[p] = add(out[p], beta)
+    return out
+
+
+def weighted_heavy_hitters(measurements: Sequence[tuple], threshold: int,
+                           bit_len: int) -> list:
+    """The level-by-level refinement loop over exact weights."""
+    prefixes = [(False,), (True,)]
+    for level in range(bit_len):
+        weights = prefix_weights(measurements, prefixes,
+                                 zero=lambda: 0, add=lambda a, b: a + b)
+        survivors = [p for p in prefixes if weights[p] >= threshold]
+        if level < bit_len - 1:
+            prefixes = [p + (bit,) for p in survivors
+                        for bit in (False, True)]
+        else:
+            return sorted(survivors)
+    return sorted(survivors)

@@ -1,0 +1,17 @@
+"""Multi-chip scaling: device meshes, sharded prep, collective
+aggregation.
+
+The reference is a single Python process (SURVEY.md §2.3); the two
+protocol axes that parallelize are reports (data parallel) and
+prefix-tree nodes (the within-level grid).  Here both are mesh axes:
+reports shard across chips like a batch, nodes like a sequence, and
+share aggregation is an XLA all-reduce over the report axis riding
+ICI.  Inter-*party* traffic (leader <-> helper) stays on the host/DCN
+boundary carrying the byte-exact wire messages (mastic_tpu.mastic).
+"""
+
+from .mesh import (install_grid_sharding, make_mesh, shard_batch,
+                   sharded_gen_fn, sharded_prep_fn, sharded_round_fn)
+
+__all__ = ["install_grid_sharding", "make_mesh", "shard_batch",
+           "sharded_gen_fn", "sharded_prep_fn", "sharded_round_fn"]

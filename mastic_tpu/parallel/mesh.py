@@ -1,0 +1,140 @@
+"""Mesh construction and sharded Mastic rounds (pjit / GSPMD).
+
+Sharding layout:
+  * report-indexed arrays (nonces, keys, correction words, out shares):
+    P("reports") on the leading axis;
+  * (report x node) grids (seeds, ctrls, payloads, proofs):
+    P("reports", "nodes") — the node axis is the sequence-parallel-like
+    axis; within-level node grids are wide (the candidate-prefix
+    frontier), so sharding them over chips covers the reference's
+    "parallel over candidate prefixes" axis (SURVEY.md §2.3);
+  * aggregate shares: replicated output of an all-reduce that XLA
+    derives from the masked sum over the sharded report axis (psum
+    over ICI; reference agg_update, mastic.py:384-397).
+
+All functions jit once per (shape, level-schedule) and are reused
+across levels/rounds.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..backend.mastic_jax import BatchedMastic
+from ..backend.vidpf_jax import EvalState
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              nodes_axis: int = 1) -> Mesh:
+    """A ("reports", "nodes") mesh over the first `n_devices` devices.
+    `nodes_axis` devices are assigned to the node (prefix-grid) axis,
+    the rest to reports."""
+    devices = jax.devices()
+    if n_devices is None:
+        n_devices = len(devices)
+    if n_devices % nodes_axis != 0:
+        raise ValueError("nodes_axis must divide n_devices")
+    devs = np.asarray(devices[:n_devices]).reshape(
+        n_devices // nodes_axis, nodes_axis)
+    return Mesh(devs, ("reports", "nodes"))
+
+
+def shard_batch(mesh: Mesh, array: jax.Array,
+                node_axis: Optional[int] = None) -> jax.Array:
+    """Place a report-batched array: leading axis over "reports",
+    `node_axis` (if given) over "nodes", rest replicated."""
+    spec = [None] * array.ndim
+    spec[0] = "reports"
+    if node_axis is not None:
+        spec[node_axis] = "nodes"
+    return jax.device_put(
+        array, NamedSharding(mesh, P(*spec)))
+
+
+def install_grid_sharding(bm: BatchedMastic, mesh: Mesh) -> None:
+    """Keep every level's (reports x nodes) eval grid distributed over
+    both mesh axes (seed/proof carry a trailing byte axis, w two
+    trailing limb axes)."""
+
+    def constrain(state: EvalState) -> EvalState:
+        def c(x):
+            spec = ["reports", "nodes"] + [None] * (x.ndim - 2)
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, P(*spec)))
+
+        return EvalState(seed=c(state.seed), ctrl=c(state.ctrl),
+                         w=c(state.w), proof=c(state.proof))
+
+    bm.vidpf.constrain_state = constrain
+
+
+def sharded_prep_fn(bm: BatchedMastic, mesh: Mesh, agg_id: int,
+                    verify_key: bytes, ctx: bytes, agg_param):
+    """Jit one aggregator's full prep over the mesh.
+
+    Returns fn(nonces, cws, keys[, proof_shares | seeds][, peer_parts])
+    -> BatchedPrep with report-sharded outputs.  The (reports x nodes)
+    intermediates inside eval are sharded over both mesh axes via a
+    sharding constraint on the root state.
+    """
+    rep = NamedSharding(mesh, P("reports"))
+
+    def fn(nonces, cws, keys, proof_shares=None, seeds=None,
+           peer_parts=None):
+        nonces = jax.lax.with_sharding_constraint(nonces, rep)
+        return bm.prep(agg_id, verify_key, ctx, agg_param, nonces, cws,
+                       keys, proof_shares=proof_shares, seeds=seeds,
+                       peer_jr_parts=peer_parts)
+
+    return jax.jit(fn)
+
+
+def sharded_round_fn(bm: BatchedMastic, mesh: Mesh, verify_key: bytes,
+                     ctx: bytes, agg_param):
+    """Jit a full two-party simulated round (no weight check): both
+    preps, the on-device eval-proof comparison, and the masked
+    aggregation whose sum over the sharded report axis lowers to an
+    all-reduce (psum) across chips.
+
+    Weight-check rounds additionally exchange FLP verifier shares —
+    driven by the host (drivers/heavy_hitters.py), since that exchange
+    crosses the aggregator trust boundary anyway.
+
+    Returns fn(nonces, cws, keys0, keys1)
+    -> (agg_share0, agg_share1, accept, ok).
+    """
+    (_level, _prefixes, do_weight_check) = agg_param
+    if do_weight_check:
+        raise ValueError("fully-fused rounds require "
+                         "do_weight_check=False")
+    rep = NamedSharding(mesh, P("reports"))
+    out_rep = NamedSharding(mesh, P())
+
+    def fn(nonces, cws, keys0, keys1):
+        nonces = jax.lax.with_sharding_constraint(nonces, rep)
+        p0 = bm.prep(0, verify_key, ctx, agg_param, nonces, cws, keys0)
+        p1 = bm.prep(1, verify_key, ctx, agg_param, nonces, cws, keys1)
+        accept = jnp.all(p0.eval_proof == p1.eval_proof, axis=-1)
+        ok = p0.ok & p1.ok
+        agg0 = bm.aggregate(p0.out_share, accept)
+        agg1 = bm.aggregate(p1.out_share, accept)
+        return (agg0, agg1, accept, ok)
+
+    return jax.jit(fn, out_shardings=(out_rep, out_rep,
+                                      NamedSharding(mesh, P("reports")),
+                                      NamedSharding(mesh, P("reports"))))
+
+
+def sharded_gen_fn(bm: BatchedMastic, mesh: Mesh, ctx: bytes):
+    """Jit batched client-side VIDPF key generation with reports
+    sharded across the mesh (the client fleet axis)."""
+    rep = NamedSharding(mesh, P("reports"))
+
+    def fn(alphas, betas, nonces, rand):
+        alphas = jax.lax.with_sharding_constraint(alphas, rep)
+        return bm.vidpf.gen(alphas, betas, ctx, nonces, rand)
+
+    return jax.jit(fn)

@@ -64,12 +64,20 @@ def _run_round(mastic, measurements, agg_param, seed=0):
         peer_parts = [None, None]
         seeds = [None, helper_seeds]
 
+    import jax
+
+    def prep0(n, c, k, p, s, jr):
+        return bm.prep(0, VERIFY_KEY, CTX, agg_param, n, c, k,
+                       proof_shares=p, seeds=s, peer_jr_parts=jr)
+
+    def prep1(n, c, k, s, jr):
+        return bm.prep(1, VERIFY_KEY, CTX, agg_param, n, c, k,
+                       seeds=s, peer_jr_parts=jr)
+
     preps = [
-        bm.prep(0, VERIFY_KEY, CTX, agg_param, nonces, cws, keys[0],
-                proof_shares=leader_proofs, seeds=seeds[0],
-                peer_jr_parts=peer_parts[0]),
-        bm.prep(1, VERIFY_KEY, CTX, agg_param, nonces, cws, keys[1],
-                seeds=seeds[1], peer_jr_parts=peer_parts[1]),
+        jax.jit(prep0)(nonces, cws, keys[0], leader_proofs, seeds[0],
+                       peer_parts[0]),
+        jax.jit(prep1)(nonces, cws, keys[1], seeds[1], peer_parts[1]),
     ]
     assert bool(np.all(np.asarray(preps[0].ok)))
     assert bool(np.all(np.asarray(preps[1].ok)))

@@ -5,6 +5,7 @@ so byte-equality here extends that lock to the batched backend.
 """
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 import pytest
 
@@ -40,8 +41,9 @@ def _batched_gen(batched, alphas, betas_scalar, nonces, rand):
         np.stack([batched.spec.int_to_limbs(x.int()) for x in beta])
         for beta in betas_scalar
     ])
-    return batched.gen(jnp.asarray(alphas), jnp.asarray(betas), CTX,
-                       jnp.asarray(nonces), jnp.asarray(rand))
+    gen = jax.jit(lambda a, b, n, r: batched.gen(a, b, CTX, n, r))
+    return gen(jnp.asarray(alphas), jnp.asarray(betas),
+               jnp.asarray(nonces), jnp.asarray(rand))
 
 
 @pytest.mark.parametrize("field,bits,value_len",
@@ -85,8 +87,10 @@ def test_eval_matches_scalar(field, bits, value_len, level):
     sched = LevelSchedule(prefixes, level, bits)
 
     for agg_id in range(2):
-        (levels, out_w, ok) = batched.eval_full(
-            agg_id, cws, keys[:, agg_id], sched, CTX, jnp.asarray(nonces))
+        eval_fn = jax.jit(lambda c, k, n, a=agg_id: batched.eval_full(
+            a, c, k, sched, CTX, n))
+        (levels, out_w, ok) = eval_fn(cws, keys[:, agg_id],
+                                      jnp.asarray(nonces))
         assert bool(np.all(ok))
 
         for r in range(alphas.shape[0]):
@@ -123,8 +127,9 @@ def test_beta_share_matches_scalar():
     (cws, keys, _) = _batched_gen(batched, alphas, betas_scalar, nonces,
                                   rand)
     for agg_id in range(2):
-        (share, ok) = batched.get_beta_share(
-            agg_id, cws, keys[:, agg_id], CTX, jnp.asarray(nonces))
+        beta_fn = jax.jit(lambda c, k, n, a=agg_id:
+                          batched.get_beta_share(a, c, k, CTX, n))
+        (share, ok) = beta_fn(cws, keys[:, agg_id], jnp.asarray(nonces))
         assert bool(np.all(ok))
         for r in range(alphas.shape[0]):
             cws_ref = batched.cws_to_host(cws, r)

@@ -1,0 +1,82 @@
+"""Driver tests: heavy hitters vs the functional oracle, attribute
+metrics, and the communication report vs the measured size formulas
+(SURVEY.md §2.4)."""
+
+import numpy as np
+
+from mastic_tpu import MasticCount, MasticSum
+from mastic_tpu.drivers import (aggregate_by_attribute,
+                                communication_report,
+                                compute_heavy_hitters, get_threshold,
+                                get_reports_from_measurements,
+                                hash_attribute)
+from mastic_tpu.oracle import weighted_heavy_hitters
+
+
+def test_heavy_hitters_matches_oracle():
+    bits = 4
+    mastic = MasticCount(bits)
+    ctx = b"hh driver test"
+    values = [0b1001, 0b0000, 0b0000, 0b0000, 0b1001, 0b0000, 0b1100,
+              0b0011, 0b1111, 0b1111]
+    weights = [1, 1, 0, 1, 1, 1, 1, 1, 0, 1]
+    measurements = [
+        (mastic.vidpf.test_index_from_int(v, bits), w)
+        for (v, w) in zip(values, weights)
+    ]
+    reports = get_reports_from_measurements(mastic, ctx, measurements)
+    got = compute_heavy_hitters(mastic, ctx, {"default": 2}, reports)
+    want = weighted_heavy_hitters(measurements, 2, bits)
+    assert sorted(got) == want
+    assert want  # the example is non-trivial
+
+
+def test_heavy_hitters_per_prefix_thresholds():
+    bits = 3
+    mastic = MasticCount(bits)
+    ctx = b"hh thresholds"
+    values = [0b000, 0b000, 0b001, 0b100, 0b101, 0b110]
+    measurements = [
+        (mastic.vidpf.test_index_from_int(v, bits), 1) for v in values
+    ]
+    reports = get_reports_from_measurements(mastic, ctx, measurements)
+    # Default threshold 2; subtree under (True,) uses threshold 1.
+    thresholds = {"default": 2, (True,): 1}
+    got = compute_heavy_hitters(mastic, ctx, thresholds, reports)
+    assert sorted(got) == [
+        (False, False, False),
+        (True, False, False),
+        (True, False, True),
+        (True, True, False),
+    ]
+    assert get_threshold(thresholds, (True, False, False)) == 1
+    assert get_threshold(thresholds, (False, False, True)) == 2
+
+
+def test_attribute_metrics():
+    mastic = MasticSum(8, 3)
+    ctx = b"attr metrics"
+    votes = [("United States", 1), ("Greece", 1), ("United States", 2),
+             ("Greece", 0), ("United States", 0), ("India", 1),
+             ("Greece", 0), ("United States", 1), ("Greece", 1),
+             ("Greece", 3), ("Greece", 1)]
+    reports = get_reports_from_measurements(
+        mastic, ctx,
+        [(hash_attribute(mastic, a), v) for (a, v) in votes])
+    result = aggregate_by_attribute(
+        mastic, ctx, ["Greece", "Mexico", "United States"], reports)
+    assert result == [("Greece", 6), ("Mexico", 0),
+                      ("United States", 4)]
+
+
+def test_communication_report_matches_formulas():
+    sizes = communication_report(print_fn=lambda *_: None)
+    # Public-share formula: ceil(2b/8) + b*(16 + v*elem + 32)
+    # (SURVEY.md §2.4, verified against the conformance vectors).
+    count = sizes["MasticCount(256)"]
+    assert count["public_share"] == 64 + 256 * (16 + 2 * 8 + 32)
+    assert count["leader_share"] == 16 + 5 * 8
+    assert count["helper_share"] == 16 + 32
+    hist = sizes["MasticHistogram(32, 100, 10)"]
+    assert hist["public_share"] == 8 + 32 * (16 + 101 * 16 + 32)
+    assert hist["helper_share"] == 16 + 32 + 32
