@@ -1,6 +1,6 @@
 """Benchmark: steady-state VIDPF evaluation throughput on one chip.
 
-Prints ONE JSON line:
+Prints ONE JSON line on stdout:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
 The metric is the BASELINE.json north star — VIDPF node evaluations
@@ -17,26 +17,58 @@ Shapes mimic the heavy-hitters steady state: a pruned frontier of
 constant width marching down a 256-level tree; each timed step is one
 tree level over (reports x frontier) with a traced node binder so a
 single compiled program serves every level.
+
+Fail-open design: every phase (import / device / scalar baseline /
+tiny sanity / compile / warmup / measure) stamps progress to stderr
+and updates a shared partial-result record; the watchdog prints the
+best measurement completed so far (tiny-shape rate if the full shape
+never finished, scalar baseline if the chip never came up) instead of
+a bare zero, with the failing phase named in "error".
 """
 
 import argparse
 import json
 import os
+import socket
 import sys
 import threading
 import time
 
+_T0 = time.time()
+
+# Partial-result record, updated as phases complete; the watchdog and
+# any exception handler print it so a hang/crash still yields data.
+PARTIAL = {
+    "metric": "vidpf_node_evals_per_sec_per_chip_256bit",
+    "value": 0.0,
+    "unit": "evals/s",
+    "vs_baseline": 0.0,
+    "phase": "start",
+}
+
+
+def stamp(phase: str, **info) -> None:
+    """Progress line on stderr + phase update for the fail-open JSON."""
+    PARTIAL["phase"] = phase
+    extra = " ".join(f"{k}={v}" for (k, v) in info.items())
+    print(f"[bench {time.time() - _T0:7.1f}s] {phase} {extra}".rstrip(),
+          file=sys.stderr, flush=True)
+
+
+def emit(error: str | None = None) -> None:
+    out = dict(PARTIAL)
+    phase = out.pop("phase")
+    if error is not None:
+        out["error"] = f"{error} (last phase: {phase})"
+    print(json.dumps(out), flush=True)
+
 
 def _watchdog(seconds: float):
-    """Emit a failure JSON line and hard-exit if the chip never comes
-    up (the remote-TPU tunnel can block indefinitely)."""
+    """Emit the partial result and hard-exit if any phase hangs (the
+    remote-TPU tunnel can block indefinitely on attach)."""
 
     def fire():
-        print(json.dumps({
-            "metric": "vidpf_node_evals_per_sec_per_chip_256bit",
-            "value": 0.0, "unit": "evals/s",
-            "vs_baseline": 0.0, "error": "watchdog timeout",
-        }), flush=True)
+        emit(error=f"watchdog timeout after {seconds:.0f}s")
         os._exit(2)
 
     timer = threading.Timer(seconds, fire)
@@ -67,74 +99,74 @@ def scalar_rate(bits: int = 256, level: int = 3) -> float:
     return nodes / dt
 
 
-def batched_rate(reports: int, frontier: int, steps: int,
-                 bits: int = 256) -> float:
-    """Steady-state node evals/sec of the batched backend on the
-    default chip."""
-    import numpy as np
-    import jax
-    import jax.numpy as jnp
+class SteadyState:
+    """The compiled one-level step at a given (reports, frontier)."""
 
-    from mastic_tpu import MasticCount
-    from mastic_tpu.backend.mastic_jax import BatchedMastic
-    from mastic_tpu.backend.vidpf_jax import EvalState
+    def __init__(self, bm, reports: int, frontier: int, bits: int):
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
 
-    bm = BatchedMastic(MasticCount(bits))
-    vid = bm.vidpf
-    ctx = b"bench"
-    rng = np.random.default_rng(0)
+        from mastic_tpu.backend.vidpf_jax import EvalState
 
-    nonces = jnp.asarray(rng.integers(0, 256, (reports, 16),
-                                      dtype=np.uint8))
-    roundkeys = jax.jit(lambda n: vid.roundkeys(ctx, n))
-    (ext_rk, conv_rk) = roundkeys(nonces)
+        vid = bm.vidpf
+        ctx = b"bench"
+        rng = np.random.default_rng(0)
+        nonces = jnp.asarray(rng.integers(0, 256, (reports, 16),
+                                          dtype=np.uint8))
+        (ext_rk, conv_rk) = jax.jit(
+            lambda n: vid.roundkeys(ctx, n))(nonces)
+        jax.block_until_ready(ext_rk)
 
-    # One level's inputs; binder is traced so one compile serves all
-    # levels (at depth >= 248 the path encoding is 32 bytes).
-    def mk_state(num_nodes):
-        return EvalState(
-            seed=jnp.asarray(rng.integers(
-                0, 256, (reports, num_nodes, 16), dtype=np.uint8)),
-            ctrl=jnp.asarray(rng.integers(
-                0, 2, (reports, num_nodes)).astype(bool)),
-            w=jnp.zeros((reports, num_nodes, 2, 4), jnp.uint32),
-            proof=jnp.zeros((reports, num_nodes, 32), jnp.uint8),
+        self.cw = (
+            jnp.asarray(rng.integers(0, 256, (reports, 16), np.uint8)),
+            jnp.asarray(rng.integers(0, 2, (reports, 2)).astype(bool)),
+            jnp.asarray(rng.integers(0, 1 << 16, (reports, 2, 4),
+                                     dtype=np.uint32)),
+            jnp.asarray(rng.integers(0, 256, (reports, 32), np.uint8)),
         )
+        # Binder is traced data so one compile serves every level (at
+        # depth >= 248 of a 256-bit tree the path encoding is 32 B).
+        self.binder = jnp.asarray(rng.integers(
+            0, 256, (2 * frontier, 36), dtype=np.uint8))
+        keep = np.arange(0, 2 * frontier, 2)
 
-    cw = (
-        jnp.asarray(rng.integers(0, 256, (reports, 16), np.uint8)),
-        jnp.asarray(rng.integers(0, 2, (reports, 2)).astype(bool)),
-        jnp.asarray(rng.integers(0, 1 << 16, (reports, 2, 4),
-                                 dtype=np.uint32)),
-        jnp.asarray(rng.integers(0, 256, (reports, 32), np.uint8)),
-    )
-    binder = jnp.asarray(rng.integers(0, 256, (2 * frontier, 36),
-                                      dtype=np.uint8))
-    keep = np.arange(0, 2 * frontier, 2)
+        def step(seed, ctrl, binder):
+            parents = EvalState(
+                seed=seed, ctrl=ctrl,
+                w=jnp.zeros((reports, frontier, vid.VALUE_LEN,
+                             bm.spec.num_limbs), jnp.uint32),
+                proof=jnp.zeros((reports, frontier, 32), jnp.uint8))
+            (child, ok) = vid.eval_step(ext_rk, conv_rk, parents,
+                                        self.cw, ctx, binder)
+            # Prune back to the frontier width (threshold survivors).
+            return (child.seed[:, keep], child.ctrl[:, keep],
+                    child.proof, ok)
 
-    @jax.jit
-    def step(seed, ctrl, binder):
-        parents = EvalState(seed=seed, ctrl=ctrl,
-                            w=jnp.zeros_like(state.w),
-                            proof=jnp.zeros_like(state.proof))
-        (child, ok) = vid.eval_step(ext_rk, conv_rk, parents, cw, ctx,
-                                    binder)
-        # Prune back to the frontier width (threshold survivors).
-        return (child.seed[:, keep], child.ctrl[:, keep],
-                child.proof, ok)
+        self.seed = jnp.asarray(rng.integers(
+            0, 256, (reports, frontier, 16), dtype=np.uint8))
+        self.ctrl = jnp.asarray(rng.integers(
+            0, 2, (reports, frontier)).astype(bool))
+        self.step = jax.jit(step)
+        self.jax = jax
+        self.evals_per_step = reports * 2 * frontier
 
-    state = mk_state(frontier)
-    (seed, ctrl) = (state.seed, state.ctrl)
-    # Warmup / compile.
-    (seed, ctrl, _, _) = step(seed, ctrl, binder)
-    jax.block_until_ready(seed)
+    def compile(self) -> float:
+        t0 = time.perf_counter()
+        compiled = self.step.lower(self.seed, self.ctrl,
+                                   self.binder).compile()
+        dt = time.perf_counter() - t0
+        self.step = compiled
+        return dt
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        (seed, ctrl, proof, ok) = step(seed, ctrl, binder)
-    jax.block_until_ready(seed)
-    dt = time.perf_counter() - t0
-    return reports * 2 * frontier * steps / dt
+    def run(self, steps: int) -> float:
+        (seed, ctrl) = (self.seed, self.ctrl)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            (seed, ctrl, _proof, _ok) = self.step(seed, ctrl, self.binder)
+        self.jax.block_until_ready(seed)
+        dt = time.perf_counter() - t0
+        return self.evals_per_step * steps / dt
 
 
 def main():
@@ -142,12 +174,14 @@ def main():
     parser.add_argument("--reports", type=int, default=4096)
     parser.add_argument("--frontier", type=int, default=64)
     parser.add_argument("--steps", type=int, default=16)
+    parser.add_argument("--bits", type=int, default=256)
     parser.add_argument("--cpu", action="store_true",
                         help="force the CPU backend (local sanity)")
     parser.add_argument("--watchdog", type=float, default=900.0)
     args = parser.parse_args()
 
     timer = _watchdog(args.watchdog)
+    stamp("import-jax")
     if args.cpu:
         os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
@@ -155,17 +189,57 @@ def main():
     requested = os.environ.get("JAX_PLATFORMS", "").strip()
     if requested and "axon" not in requested.split(","):
         jax.config.update("jax_platforms", requested)
+    # Persistent compile cache, keyed by host so a cache built on a
+    # different machine type is never reused (XLA rejects mismatched
+    # machine types with noisy warnings and, historically, SIGILL).
+    cache = f"/tmp/mastic_tpu_jax_cache_{socket.gethostname()}"
+    jax.config.update("jax_compilation_cache_dir", cache)
 
-    base = scalar_rate()
-    rate = batched_rate(args.reports, args.frontier, args.steps)
+    stamp("scalar-baseline")
+    base = scalar_rate(bits=args.bits)
+    PARTIAL["scalar_evals_per_sec"] = round(base, 1)
+    stamp("device-attach")
+    devices = jax.devices()
+    stamp("device-up", devices=devices)
+
+    from mastic_tpu import MasticCount
+    from mastic_tpu.backend.mastic_jax import BatchedMastic
+    bm = BatchedMastic(MasticCount(args.bits))
+
+    # Tiny-shape sanity: proves chip + kernels work before the big
+    # compile; its rate is the fail-open fallback value.
+    stamp("tiny-sanity-compile", reports=64, frontier=8)
+    tiny = SteadyState(bm, 64, 8, args.bits)
+    tiny_compile = tiny.compile()
+    tiny_rate = tiny.run(4)
+    PARTIAL["value"] = round(tiny_rate, 1)
+    PARTIAL["vs_baseline"] = round(tiny_rate / base, 1)
+    PARTIAL["note"] = "tiny-shape (64x8) fallback rate"
+    stamp("tiny-sanity-done", rate=f"{tiny_rate:.0f}",
+          compile_s=f"{tiny_compile:.1f}")
+
+    stamp("full-compile", reports=args.reports, frontier=args.frontier)
+    full = SteadyState(bm, args.reports, args.frontier, args.bits)
+    compile_s = full.compile()
+    stamp("warmup", compile_s=f"{compile_s:.1f}")
+    full.run(2)
+    stamp("measure")
+    rate = full.run(args.steps)
     timer.cancel()
-    print(json.dumps({
-        "metric": "vidpf_node_evals_per_sec_per_chip_256bit",
-        "value": round(rate, 1),
-        "unit": "evals/s",
-        "vs_baseline": round(rate / base, 1),
-    }), flush=True)
+
+    PARTIAL.pop("note", None)
+    PARTIAL["value"] = round(rate, 1)
+    PARTIAL["vs_baseline"] = round(rate / base, 1)
+    PARTIAL["compile_seconds"] = round(compile_s, 1)
+    PARTIAL["reports"] = args.reports
+    PARTIAL["frontier"] = args.frontier
+    stamp("done", rate=f"{rate:.0f}")
+    emit()
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as exc:  # fail open: report what we had
+        emit(error=f"{type(exc).__name__}: {exc}")
+        raise

@@ -17,6 +17,7 @@ protocol parameters (reference poc/vidpf.py:366-380, poc/mastic.py:
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..keccak import RHO_OFFSETS, ROUND_CONSTANTS
 
@@ -78,8 +79,12 @@ def _keccak_round(lo: jax.Array, hi: jax.Array, rc_lo: jax.Array,
             jnp.stack([x[1] for x in a], axis=-1))
 
 
-_RC_LO = jnp.asarray([rc & 0xFFFFFFFF for rc in ROUND_CONSTANTS], _U32)
-_RC_HI = jnp.asarray([rc >> 32 for rc in ROUND_CONSTANTS], _U32)
+# Kept as numpy at module scope so importing this module never
+# initializes the JAX backend (callers may still need to override the
+# platform); jnp.asarray at use site is constant-folded by XLA.
+_RC_LO = np.asarray([rc & 0xFFFFFFFF for rc in ROUND_CONSTANTS],
+                    np.uint32)
+_RC_HI = np.asarray([rc >> 32 for rc in ROUND_CONSTANTS], np.uint32)
 
 
 def keccak_p1600(lo: jax.Array, hi: jax.Array, num_rounds: int = 12):
@@ -99,7 +104,8 @@ def keccak_p1600(lo: jax.Array, hi: jax.Array, num_rounds: int = 12):
 
     start = 24 - num_rounds
     ((lo, hi), _) = jax.lax.scan(
-        body, (lo, hi), (_RC_LO[start:], _RC_HI[start:]))
+        body, (lo, hi),
+        (jnp.asarray(_RC_LO[start:]), jnp.asarray(_RC_HI[start:])))
     return (lo, hi)
 
 

@@ -22,14 +22,15 @@ def prefix_weights(measurements: Sequence[tuple], prefixes: Sequence[tuple],
 def weighted_heavy_hitters(measurements: Sequence[tuple], threshold: int,
                            bit_len: int) -> list:
     """The level-by-level refinement loop over exact weights."""
+    if bit_len < 1:
+        raise ValueError("bit_len must be >= 1")
     prefixes = [(False,), (True,)]
     for level in range(bit_len):
         weights = prefix_weights(measurements, prefixes,
                                  zero=lambda: 0, add=lambda a, b: a + b)
         survivors = [p for p in prefixes if weights[p] >= threshold]
-        if level < bit_len - 1:
-            prefixes = [p + (bit,) for p in survivors
-                        for bit in (False, True)]
-        else:
+        if level == bit_len - 1:
             return sorted(survivors)
-    return sorted(survivors)
+        prefixes = [p + (bit,) for p in survivors
+                    for bit in (False, True)]
+    raise AssertionError("unreachable")

@@ -35,8 +35,8 @@ def test_sharded_round_matches_unsharded():
     assert len(jax.devices()) == 8
     mastic = MasticCount(3)
     bm = BatchedMastic(mastic)
-    reports = _reports(mastic, [0b101, 0b100, 0b101, 0b001,
-                                0b101, 0b100, 0b110, 0b000])
+    values = [0b101, 0b100, 0b101, 0b001, 0b101, 0b100, 0b110, 0b000]
+    reports = _reports(mastic, values)
     level = 1
     prefixes = tuple(mastic.vidpf.test_index_from_int(v, 2)
                      for v in range(4))
@@ -77,7 +77,8 @@ def test_sharded_round_matches_unsharded():
         agg_param,
         [bm.agg_share_to_host(agg0), bm.agg_share_to_host(agg1)],
         len(reports))
-    assert result == [1, 1, 4, 1]
+    assert result == [sum(1 for v in values if v >> 1 == p)
+                      for p in range(4)]
 
 
 def _round(bm, agg_param, nonces, cws, k0, k1):
