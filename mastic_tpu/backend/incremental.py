@@ -1,0 +1,394 @@
+"""Cross-round incremental VIDPF evaluation for heavy hitters.
+
+The reference notes that an aggregator can cache the prefix tree
+across collection rounds (/root/reference/poc/vidpf.py:243-245); the
+batched backend makes that the core execution model:
+
+* each aggregator carries, per tree depth, the payload + node-proof
+  arrays of every node materialized so far (pruned each round to the
+  ancestors of the live candidate-prefix set), plus the full
+  seed/ctrl state of the newest depth;
+* a round at level L gathers the surviving depth-(L-1) parents and
+  runs ONE batched eval_step — O(frontier) node evaluations per round
+  instead of re-walking the whole tree from the root (O(level *
+  frontier));
+* the eval-proof binders (payload / onehot checks, reference
+  mastic.py:258-287) still cover the full ancestor tree byte-exactly:
+  they are assembled from the carried arrays with host-computed
+  permutations and hashed with a runtime-length sponge.
+
+Shapes are *capacity-static*: every per-depth array is padded to a
+fixed node width W and the depth axis to BITS, with live counts /
+gather indices / binder lengths passed as runtime inputs.  One
+compiled program therefore serves every level of a heavy-hitters run
+(the ragged-frontier strategy of SURVEY.md §7 hard part 5).
+
+The host side (RoundPlan) mirrors backend/schedule.py but emits
+runtime index tensors instead of baked constants.
+"""
+
+from typing import NamedTuple, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..common import to_le_bytes
+from ..dst import (USAGE_EVAL_PROOF, USAGE_NODE_PROOF,
+                   USAGE_ONEHOT_CHECK, USAGE_PAYLOAD_CHECK, dst, dst_alg)
+from ..ops.keccak_jax import turbo_shake128_dynamic
+from ..vidpf import PROOF_SIZE, Path, encode_path
+from .mastic_jax import BatchedMastic
+from .vidpf_jax import KEY_SIZE, EvalState
+from .xof_jax import ts_prefix, turboshake_xof
+
+_U8 = jnp.uint8
+
+
+class Carry(NamedTuple):
+    """One aggregator's cross-round state.
+
+    w      (R, BITS, W, VALUE_LEN, n) plain limbs, rows 0..level live
+    proof  (R, BITS, W, 32) uint8
+    seed   (R, W, 16) uint8 — newest depth only (the PRG frontier)
+    ctrl   (R, W) bool
+    """
+    w: jax.Array
+    proof: jax.Array
+    seed: jax.Array
+    ctrl: jax.Array
+
+
+class RoundPlan:
+    """Host-side runtime inputs for one incremental round.
+
+    Mirrors the reference's lazily-materialized tree for candidate set
+    `prefixes` at `level`: per depth d, the live nodes are
+    needed[d] = both children of every ancestor of `prefixes` at depth
+    d-1 (lexicographic), which is exactly the reference's BFS
+    materialization order (mastic.py:258-287).
+    """
+
+    def __init__(self, prefixes: Sequence[Path], level: int, bits: int,
+                 width: int, prev_paths: Optional[list[Path]],
+                 carried_paths: list[list[Path]]):
+        if any(len(p) != level + 1 for p in prefixes):
+            raise ValueError("prefix with incorrect length")
+        if len(set(prefixes)) != len(prefixes):
+            raise ValueError("candidate prefixes are non-unique")
+        half = width // 2
+        self.level = level
+        self.width = width
+        self.prefixes = tuple(prefixes)
+
+        anc: list[list[Path]] = [
+            sorted(set(p[:d + 1] for p in prefixes))
+            for d in range(level + 1)
+        ]
+        if any(len(a) > half for a in anc):
+            raise ValueError("frontier exceeds padded width")
+        needed: list[list[Path]] = [
+            [par + (b,) for par in (anc[d - 1] if d else [()])
+             for b in (False, True)]
+            for d in range(level + 1)
+        ]
+        self.needed = needed
+
+        # Prune gather: position of needed[d] inside the previously
+        # carried paths at depth d (identity row for the new level).
+        self.prune_idx = np.zeros((bits, width), np.int32)
+        self.counts = np.zeros(bits, np.int32)
+        for d in range(level):
+            pos = {p: i for (i, p) in enumerate(carried_paths[d])}
+            for (i, p) in enumerate(needed[d]):
+                self.prune_idx[d, i] = pos[p]
+            self.counts[d] = len(needed[d])
+        self.prune_idx[level] = np.arange(width)
+        self.counts[level] = len(needed[level])
+
+        # Parents of the new level inside the previous frontier state.
+        self.parent_idx = np.zeros(half, np.int32)
+        if level == 0:
+            self.parent_count = 1
+        else:
+            assert prev_paths is not None
+            pos = {p: i for (i, p) in enumerate(prev_paths)}
+            parents = anc[level - 1]
+            for (i, p) in enumerate(parents):
+                self.parent_idx[i] = pos[p]
+            self.parent_count = len(parents)
+
+        # Node-proof binder bytes for the new children (runtime data;
+        # one row per child, same for every report).
+        path_bytes = (bits + 7) // 8
+        self.binder_capacity = 4 + path_bytes
+        self.node_binder = np.zeros((width, self.binder_capacity),
+                                    np.uint8)
+        head = to_le_bytes(bits, 2) + to_le_bytes(level, 2)
+        for (i, p) in enumerate(needed[level]):
+            row = head + encode_path(p)
+            self.node_binder[i, :len(row)] = np.frombuffer(row, np.uint8)
+        self.binder_len = 4 + (level + 1 + 7) // 8
+
+        # Onehot-check permutation: flatten (depth, node) rows of the
+        # carried proof arrays into BFS order.
+        rows = []
+        for d in range(level + 1):
+            rows += [d * width + i for i in range(len(needed[d]))]
+        self.onehot_perm = np.zeros(bits * width, np.int32)
+        self.onehot_perm[:len(rows)] = rows
+        self.onehot_rows = len(rows)
+
+        # Payload-check permutation over (depth, parent-slot) rows:
+        # parents at depth d are anc[d] located inside needed[d].
+        self.internal_idx = np.zeros((bits, half), np.int32)
+        prows = []
+        for d in range(level):
+            pos = {p: i for (i, p) in enumerate(needed[d])}
+            for (i, p) in enumerate(anc[d]):
+                self.internal_idx[d, i] = pos[p]
+                prows.append(d * half + i)
+        self.payload_perm = np.zeros(bits * half, np.int32)
+        self.payload_perm[:len(prows)] = prows
+        self.payload_rows = len(prows)
+
+        # Output gather: position of each prefix in needed[level].
+        pos = {p: i for (i, p) in enumerate(needed[level])}
+        self.out_idx = np.zeros(half, np.int32)
+        for (i, p) in enumerate(self.prefixes):
+            self.out_idx[i] = pos[p]
+        self.num_out = len(self.prefixes)
+
+
+class IncrementalRound(NamedTuple):
+    """Traced inputs derived from a RoundPlan."""
+    level: jax.Array          # () int32
+    prune_idx: jax.Array      # (BITS, W)
+    parent_idx: jax.Array     # (W/2,)
+    parent_count: jax.Array   # () int32
+    node_binder: jax.Array    # (W, B)
+    binder_len: jax.Array     # () int32
+    onehot_perm: jax.Array    # (BITS*W,)
+    onehot_rows: jax.Array    # () int32
+    internal_idx: jax.Array   # (BITS, W/2)
+    payload_perm: jax.Array   # (BITS*W/2,)
+    payload_rows: jax.Array   # () int32
+    out_idx: jax.Array        # (W/2,)
+
+
+def round_inputs(plan: RoundPlan) -> IncrementalRound:
+    return IncrementalRound(
+        level=jnp.int32(plan.level),
+        prune_idx=jnp.asarray(plan.prune_idx),
+        parent_idx=jnp.asarray(plan.parent_idx),
+        parent_count=jnp.int32(plan.parent_count),
+        node_binder=jnp.asarray(plan.node_binder),
+        binder_len=jnp.int32(plan.binder_len),
+        onehot_perm=jnp.asarray(plan.onehot_perm),
+        onehot_rows=jnp.int32(plan.onehot_rows),
+        internal_idx=jnp.asarray(plan.internal_idx),
+        payload_perm=jnp.asarray(plan.payload_perm),
+        payload_rows=jnp.int32(plan.payload_rows),
+        out_idx=jnp.asarray(plan.out_idx),
+    )
+
+
+class IncrementalMastic:
+    """The incremental round engine for one BatchedMastic instance."""
+
+    def __init__(self, bm: BatchedMastic, width: int):
+        assert width >= 2 and width & (width - 1) == 0
+        self.bm = bm
+        self.width = width
+        self.bits = bm.m.vidpf.BITS
+
+    def init_carry(self, num_reports: int, keys: jax.Array,
+                   agg_id: int) -> Carry:
+        """Pre-round-0 carry: the frontier is the root key."""
+        vid = self.bm.vidpf
+        spec = self.bm.spec
+        seed = jnp.zeros((num_reports, self.width, KEY_SIZE), _U8)
+        seed = seed.at[:, 0, :].set(keys)
+        ctrl = jnp.zeros((num_reports, self.width), bool)
+        ctrl = ctrl.at[:, 0].set(bool(agg_id))
+        return Carry(
+            w=jnp.zeros((num_reports, self.bits, self.width,
+                         vid.VALUE_LEN, spec.num_limbs), jnp.uint32),
+            proof=jnp.zeros((num_reports, self.bits, self.width,
+                             PROOF_SIZE), _U8),
+            seed=seed, ctrl=ctrl)
+
+    # -- one aggregator's round (jittable) -------------------------
+
+    def agg_round(self, agg_id: int, verify_key: bytes, ctx: bytes,
+                  carry: Carry, rnd: IncrementalRound,
+                  ext_rk: jax.Array, conv_rk: jax.Array, cws):
+        """Evaluate the new level, refresh the carry, emit the eval
+        proof and the (padded) truncated out share.
+
+        Returns (carry', eval_proof (R, 32), out_share
+        (R, W/2*(1+OUTPUT_LEN), n), ok (R,)).
+        """
+        bm = self.bm
+        spec = bm.spec
+        (num_reports, _bits, width, value_len, n) = carry.w.shape
+        half = width // 2
+
+        # 1. Prune all carried depths to the ancestors of the live
+        # candidate set (one vectorized gather per array).
+        def prune(x):
+            idx = rnd.prune_idx.reshape(
+                (1, self.bits, width) + (1,) * (x.ndim - 3))
+            return jnp.take_along_axis(x, idx, axis=2)
+
+        w_all = prune(carry.w)
+        proof_all = prune(carry.proof)
+
+        # 2. Gather the surviving parents from the frontier state.
+        pseed = carry.seed[:, rnd.parent_idx, :]
+        pctrl = carry.ctrl[:, rnd.parent_idx]
+        parents = EvalState(
+            seed=pseed, ctrl=pctrl,
+            w=jnp.zeros((num_reports, half, value_len, n), jnp.uint32),
+            proof=jnp.zeros((num_reports, half, PROOF_SIZE), _U8))
+
+        # 3. One level step with the correction word at `level`.
+        cw_slice = tuple(
+            jax.lax.dynamic_index_in_dim(x, rnd.level, axis=1,
+                                         keepdims=False)
+            for x in (cws.seed, cws.ctrl, cws.w, cws.proof))
+        (child, ok) = self._eval_step_dynamic(
+            ext_rk, conv_rk, parents, cw_slice, ctx, rnd)
+
+        # 4. Install the new depth row.
+        w_all = jax.lax.dynamic_update_slice_in_dim(
+            w_all, child.w[:, None], rnd.level, axis=1)
+        proof_all = jax.lax.dynamic_update_slice_in_dim(
+            proof_all, child.proof[:, None], rnd.level, axis=1)
+
+        # 5. Binders + checks (byte-exact vs mastic.py:219-247).
+        eval_proof = self._eval_proof(agg_id, verify_key, ctx, w_all,
+                                      proof_all, rnd)
+
+        # 6. Padded truncated out share.
+        out_w = child.w[:, rnd.out_idx]
+        if agg_id == 1:
+            out_w = spec.neg(out_w)
+        counter = out_w[..., :1, :]
+        trunc = bm.truncate(out_w[..., 1:, :])
+        out_share = jnp.concatenate([counter, trunc], axis=-2)
+        out_share = out_share.reshape(num_reports, -1, n)
+
+        carry = Carry(w=w_all, proof=proof_all, seed=child.seed,
+                      ctrl=child.ctrl)
+        return (carry, eval_proof, out_share, ok)
+
+    def _eval_step_dynamic(self, ext_rk, conv_rk, parents: EvalState,
+                           cw_slice, ctx: bytes, rnd: IncrementalRound):
+        """vidpf_jax.eval_step with a runtime-length node-proof binder."""
+        vid = self.bm.vidpf
+        (seed_cw, ctrl_cw, w_cw, proof_cw) = cw_slice
+        (num_reports, num_parents) = parents.ctrl.shape
+
+        ((s_l, s_r), (t_l, t_r)) = vid.extend(ext_rk, parents.seed)
+        sel = parents.ctrl[..., None]
+        s_l = jnp.where(sel, s_l ^ seed_cw[:, None, :], s_l)
+        s_r = jnp.where(sel, s_r ^ seed_cw[:, None, :], s_r)
+        t_l = t_l ^ (parents.ctrl & ctrl_cw[:, None, 0])
+        t_r = t_r ^ (parents.ctrl & ctrl_cw[:, None, 1])
+
+        cs = jnp.stack([s_l, s_r], axis=2).reshape(
+            num_reports, 2 * num_parents, KEY_SIZE)
+        ct = jnp.stack([t_l, t_r], axis=2).reshape(
+            num_reports, 2 * num_parents)
+
+        (next_seed, w, ok) = vid.convert(conv_rk, cs)
+        w = jnp.where(ct[..., None, None],
+                      self.bm.spec.add(w, w_cw[:, None]), w)
+
+        # Node proof with runtime-length (BITS, level, path) binder.
+        proof_dst = dst(ctx, USAGE_NODE_PROOF)
+        prefix = ts_prefix(proof_dst, KEY_SIZE)
+        msg = jnp.concatenate([
+            jnp.broadcast_to(
+                jnp.asarray(np.frombuffer(prefix, np.uint8)),
+                (num_reports, 2 * num_parents, len(prefix))),
+            next_seed,
+            jnp.broadcast_to(rnd.node_binder[None],
+                             (num_reports,) + rnd.node_binder.shape),
+        ], axis=-1)
+        proof = turbo_shake128_dynamic(
+            msg, jnp.int32(len(prefix) + KEY_SIZE) + rnd.binder_len,
+            1, PROOF_SIZE)
+        proof = jnp.where(ct[..., None], proof ^ proof_cw[:, None, :],
+                          proof)
+
+        child = EvalState(seed=next_seed, ctrl=ct, w=w, proof=proof)
+        # Only live parent lanes count toward the rejection mask.
+        lane = jnp.arange(2 * num_parents) < 2 * rnd.parent_count
+        return (child, jnp.all(ok | ~lane, axis=-1))
+
+    def _eval_proof(self, agg_id: int, verify_key: bytes, ctx: bytes,
+                    w_all, proof_all, rnd: IncrementalRound):
+        """The three checks over the carried tree, hashed with
+        runtime-length binders (scalar semantics: mastic.py:219-247)."""
+        bm = self.bm
+        spec = bm.spec
+        (num_reports, bits, width, value_len, n) = w_all.shape
+        half = width // 2
+
+        # Payload rows: parent w minus its two children, per depth.
+        parent_w = jnp.take_along_axis(
+            w_all, rnd.internal_idx[None, :, :, None, None], axis=2)
+        left = w_all[:, 1:, 0::2]
+        right = w_all[:, 1:, 1::2]
+        diff = spec.sub(parent_w[:, :bits - 1],
+                        spec.add(left, right))
+        diff_bytes = spec.plain_to_le_bytes(diff).reshape(
+            num_reports, (bits - 1) * half, -1)
+        row_bytes = diff_bytes.shape[-1]
+        # Compact rows into BFS order with the host permutation, then
+        # hash the runtime-length prefix.
+        payload_binder = diff_bytes[:, rnd.payload_perm[
+            :(bits - 1) * half]].reshape(num_reports, -1)
+        payload_check = turbo_shake128_dynamic(
+            _prefixed(payload_binder, ctx, USAGE_PAYLOAD_CHECK, bm.m.ID),
+            _prefix_len(ctx, USAGE_PAYLOAD_CHECK, bm.m.ID)
+            + rnd.payload_rows * row_bytes,
+            1, PROOF_SIZE)
+
+        onehot_binder = proof_all.reshape(
+            num_reports, bits * width, PROOF_SIZE)[
+            :, rnd.onehot_perm].reshape(num_reports, -1)
+        onehot_check = turbo_shake128_dynamic(
+            _prefixed(onehot_binder, ctx, USAGE_ONEHOT_CHECK, bm.m.ID),
+            _prefix_len(ctx, USAGE_ONEHOT_CHECK, bm.m.ID)
+            + rnd.onehot_rows * PROOF_SIZE,
+            1, PROOF_SIZE)
+
+        counter = spec.add(w_all[:, 0, 0, 0], w_all[:, 0, 1, 0])
+        if agg_id == 1:
+            one = np.zeros(spec.num_limbs, np.uint32)
+            one[0] = 1
+            counter = spec.add(counter, jnp.asarray(one))
+        counter_check = spec.plain_to_le_bytes(counter)
+
+        return turboshake_xof(
+            dst_alg(ctx, USAGE_EVAL_PROOF, bm.m.ID), verify_key,
+            (onehot_check, counter_check, payload_check), PROOF_SIZE,
+            (num_reports,))
+
+
+def _prefix_len(ctx: bytes, usage: int, alg_id: int) -> int:
+    return len(ts_prefix(dst_alg(ctx, usage, alg_id), 0))
+
+
+def _prefixed(binder: jax.Array, ctx: bytes, usage: int,
+              alg_id: int) -> jax.Array:
+    """Prepend the XofTurboShake128 empty-seed prefix so the dynamic
+    sponge sees the full message."""
+    prefix = ts_prefix(dst_alg(ctx, usage, alg_id), 0)
+    head = jnp.broadcast_to(
+        jnp.asarray(np.frombuffer(prefix, np.uint8)),
+        binder.shape[:-1] + (len(prefix),))
+    return jnp.concatenate([head, binder], axis=-1)

@@ -2,10 +2,10 @@
 
 Device twin of the scalar Mastic.prep_init / prep_shares_to_prep /
 agg_update (mastic_tpu/mastic.py, itself byte-exact vs the reference
-/root/reference/poc/mastic.py:205-397).  Everything except the FLP
-query runs on device; the FLP query falls back to the scalar layer on
-host until the batched FLP lands (it only runs on the one weight-check
-round, reference mastic.py:187-203).
+/root/reference/poc/mastic.py:205-397).  The whole round — VIDPF tree
+eval, the three verifiability checks, the FLP query/decide on
+weight-check rounds (reference mastic.py:250-256, :348-350), masked
+aggregation — runs on device; only the wire boundaries are host-side.
 
 Binder assembly order: the payload/onehot check binders concatenate
 per-depth node data in lexicographic order, which equals the
@@ -23,6 +23,7 @@ from ..dst import (USAGE_EVAL_PROOF, USAGE_JOINT_RAND,
                    USAGE_JOINT_RAND_PART, USAGE_JOINT_RAND_SEED,
                    USAGE_ONEHOT_CHECK, USAGE_PAYLOAD_CHECK,
                    USAGE_PROOF_SHARE, USAGE_QUERY_RAND, dst_alg)
+from ..flp.flp_jax import BatchedFlp
 from ..mastic import Mastic
 from ..ops.field_jax import field_sum, spec_for
 from ..vidpf import PROOF_SIZE
@@ -38,22 +39,17 @@ class BatchedPrep(NamedTuple):
 
     out_share    (R, P*(1+OUTPUT_LEN), n) plain limbs
     eval_proof   (R, 32) uint8
-    beta_share   (R, VALUE_LEN, n) plain limbs (weight-check rounds)
-    query_rand   (R, QUERY_RAND_LEN, n) or None
-    joint_rand   (R, JOINT_RAND_LEN, n) or None
+    verifier     (R, VERIFIER_LEN, n) plain limbs (weight-check rounds)
+                 — the FLP verifier share this aggregator broadcasts
     joint_rand_part / joint_rand_seed  (R, 32) uint8 or None
-    proof_share  (R, PROOF_LEN, n) plain limbs or None
     ok           (R,) bool — False where rejection sampling fired and
                  the scalar fallback must recompute this report
     """
     out_share: jax.Array
     eval_proof: jax.Array
-    beta_share: Optional[jax.Array]
-    query_rand: Optional[jax.Array]
-    joint_rand: Optional[jax.Array]
+    verifier: Optional[jax.Array]
     joint_rand_part: Optional[jax.Array]
     joint_rand_seed: Optional[jax.Array]
-    proof_share: Optional[jax.Array]
     ok: jax.Array
 
 
@@ -78,6 +74,7 @@ class BatchedMastic:
         self.spec = spec_for(mastic.field)
         self.vidpf = BatchedVidpf(mastic.field, mastic.vidpf.BITS,
                                   mastic.vidpf.VALUE_LEN)
+        self.bflp = BatchedFlp(mastic.flp)
         self._trunc = self._truncate_map()
 
     # -- truncation as a static linear map -------------------------
@@ -247,12 +244,9 @@ class BatchedMastic:
         out_share = out_share.reshape(out_share.shape[0], -1,
                                       self.spec.num_limbs)
 
-        beta_share = None
-        query_rand = None
-        joint_rand = None
+        verifier = None
         jr_part = None
         jr_seed = None
-        expanded_proof = proof_shares
         if do_weight_check:
             beta_share = self.spec.add(levels[0].w[:, 0],
                                        levels[0].w[:, 1])
@@ -261,11 +255,13 @@ class BatchedMastic:
             (query_rand, qok) = self.query_rand(verify_key, ctx, nonces,
                                                 level)
             ok = ok & qok
+            expanded_proof = proof_shares
             if agg_id == 1:
                 assert seeds is not None
                 (expanded_proof, pok) = self.helper_proof_share(ctx,
                                                                 seeds)
                 ok = ok & pok
+            joint_rand = None
             if self.m.flp.JOINT_RAND_LEN > 0:
                 assert seeds is not None
                 assert peer_jr_parts is not None
@@ -279,62 +275,33 @@ class BatchedMastic:
                                                    jr_part)
                 (joint_rand, jok) = self.joint_rand(ctx, jr_seed)
                 ok = ok & jok
+            # Device FLP query (scalar: mastic.py:250-256).
+            (verifier, vok) = self.bflp.query(
+                beta_share[..., 1:, :], expanded_proof, query_rand,
+                joint_rand, 2)
+            ok = ok & vok
 
         return BatchedPrep(
             out_share=out_share, eval_proof=eval_proof,
-            beta_share=beta_share, query_rand=query_rand,
-            joint_rand=joint_rand, joint_rand_part=jr_part,
-            joint_rand_seed=jr_seed, proof_share=expanded_proof, ok=ok)
-
-    # -- FLP query host fallback (until the batched FLP lands) -----
-
-    def flp_query_host(self, prep: BatchedPrep) -> list:
-        """Per-report verifier shares via the scalar FLP."""
-        assert prep.beta_share is not None and prep.query_rand is not None
-        field = self.m.field
-        beta = np.asarray(prep.beta_share)
-        qr = np.asarray(prep.query_rand)
-        proof = np.asarray(prep.proof_share)
-        jr = (np.asarray(prep.joint_rand)
-              if prep.joint_rand is not None else None)
-        verifiers = []
-        for r in range(beta.shape[0]):
-            meas = [field(self.spec.limbs_to_int(beta[r, j]))
-                    for j in range(1, beta.shape[1])]
-            proof_share = [field(self.spec.limbs_to_int(proof[r, j]))
-                           for j in range(proof.shape[1])]
-            query_rand = [field(self.spec.limbs_to_int(qr[r, j]))
-                          for j in range(qr.shape[1])]
-            joint_rand = [] if jr is None else \
-                [field(self.spec.limbs_to_int(jr[r, j]))
-                 for j in range(jr.shape[1])]
-            verifiers.append(self.m.flp.query(
-                meas, proof_share, query_rand, joint_rand, 2))
-        return verifiers
+            verifier=verifier, joint_rand_part=jr_part,
+            joint_rand_seed=jr_seed, ok=ok)
 
     # -- round finish (scalar: mastic.py:284-331) ------------------
 
     def accept_mask(self, prep0: BatchedPrep, prep1: BatchedPrep,
-                    do_weight_check: bool,
-                    verifiers0=None, verifiers1=None) -> np.ndarray:
+                    do_weight_check: bool) -> jax.Array:
         """Which reports pass the checks: eval proofs equal, FLP decide
-        (weight-check rounds).  Joint-rand confirmation (prep_next) is
-        seed equality, folded in here for the batched round."""
-        accept = np.array(
-            jnp.all(prep0.eval_proof == prep1.eval_proof, axis=-1))
+        over the summed verifier shares (weight-check rounds).
+        Joint-rand confirmation (prep_next) is seed equality, folded in
+        here for the batched round.  Fully on device, jittable."""
+        accept = jnp.all(prep0.eval_proof == prep1.eval_proof, axis=-1)
         if do_weight_check:
-            assert verifiers0 is not None and verifiers1 is not None
-            from ..common import vec_add
-            for r in range(len(accept)):
-                if not accept[r]:
-                    continue
-                verifier = vec_add(verifiers0[r], verifiers1[r])
-                accept[r] = self.m.flp.decide(verifier)
+            assert prep0.verifier is not None
+            verifier = self.spec.add(prep0.verifier, prep1.verifier)
+            accept = accept & self.bflp.decide(verifier)
         if prep0.joint_rand_seed is not None:
-            seeds_match = np.asarray(jnp.all(
-                prep0.joint_rand_seed == prep1.joint_rand_seed,
-                axis=-1))
-            accept = accept & seeds_match
+            accept = accept & jnp.all(
+                prep0.joint_rand_seed == prep1.joint_rand_seed, axis=-1)
         return accept
 
     def aggregate(self, out_share: jax.Array,
@@ -402,3 +369,17 @@ class BatchedMastic:
                        seeds=batch.helper_seeds,
                        peer_jr_parts=batch.peer_parts[1])
         return (p0, p1)
+
+    def round_device(self, verify_key: bytes, ctx: bytes, agg_param,
+                     batch: ReportBatch) -> tuple:
+        """One full simulated aggregation round on device: both preps,
+        all checks (incl. the FLP verifier exchange), masked
+        aggregation.  Returns (agg_share0, agg_share1, accept, ok) —
+        jittable; weight-check rounds included."""
+        (_level, _prefixes, do_weight_check) = agg_param
+        (p0, p1) = self.prep_both(verify_key, ctx, agg_param, batch)
+        accept = self.accept_mask(p0, p1, do_weight_check)
+        ok = p0.ok & p1.ok
+        agg0 = self.aggregate(p0.out_share, accept)
+        agg1 = self.aggregate(p1.out_share, accept)
+        return (agg0, agg1, accept, ok)

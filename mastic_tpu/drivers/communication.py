@@ -12,6 +12,7 @@ Prio3 implementations themselves are out of the framework's scope
 BASELINE.md, so no numbers are invented for them here.
 """
 
+from .. import testvec_codec as codec
 from ..common import gen_rand
 from ..mastic import Mastic, MasticCount, MasticHistogram, MasticSum
 
@@ -23,9 +24,9 @@ def report_sizes(mastic: Mastic, measurement) -> dict:
     rand = gen_rand(mastic.RAND_SIZE)
     (public_share, input_shares) = mastic.shard(ctx, measurement, nonce,
                                                 rand)
-    public = len(mastic.test_vec_encode_public_share(public_share))
-    leader = len(mastic.test_vec_encode_input_share(input_shares[0]))
-    helper = len(mastic.test_vec_encode_input_share(input_shares[1]))
+    public = len(codec.encode_public_share(mastic, public_share))
+    leader = len(codec.encode_input_share(mastic, input_shares[0]))
+    helper = len(codec.encode_input_share(mastic, input_shares[1]))
     return {
         "public_share": public,
         "leader_share": leader,

@@ -46,38 +46,47 @@ def get_threshold(thresholds: dict, prefix: tuple) -> int:
     return thresholds["default"]
 
 
+def _round_fn(bm: BatchedMastic, verify_key: bytes, ctx: bytes,
+              agg_param):
+    """The jitted full-round function, cached on the BatchedMastic so
+    repeated rounds with the same aggregation parameter (or repeated
+    aggregate_by_attribute calls) reuse the compiled program."""
+    cache = getattr(bm, "_round_cache", None)
+    if cache is None:
+        cache = {}
+        bm._round_cache = cache
+    key = (verify_key, ctx, agg_param)
+    fn = cache.get(key)
+    if fn is None:
+        fn = jax.jit(lambda b: bm.round_device(verify_key, ctx,
+                                               agg_param, b))
+        cache[key] = fn
+    return fn
+
+
 def run_round(bm: BatchedMastic, verify_key: bytes, ctx: bytes,
               agg_param, batch: ReportBatch,
               accept_out: Optional[list] = None) -> list:
-    """One aggregation round on the batched backend: both preps,
-    checks, masked aggregation, unshard.  Returns the per-prefix
-    aggregate result; appends the accept mask to `accept_out`."""
-    (_level, _prefixes, do_weight_check) = agg_param
-    (p0, p1) = jax.jit(
-        lambda b: bm.prep_both(verify_key, ctx, agg_param, b))(batch)
-    _require_ok(p0, p1)
-    if do_weight_check:
-        verifiers = (bm.flp_query_host(p0), bm.flp_query_host(p1))
-    else:
-        verifiers = (None, None)
-    accept = bm.accept_mask(p0, p1, do_weight_check, *verifiers)
+    """One aggregation round on the batched backend: both preps, all
+    checks (incl. the device FLP on weight-check rounds), masked
+    aggregation, unshard.  Returns the per-prefix aggregate result;
+    appends the accept mask to `accept_out`."""
+    (agg0, agg1, accept, ok) = _round_fn(bm, verify_key, ctx,
+                                         agg_param)(batch)
+    _require_ok(ok)
+    accept = np.asarray(accept)
     if accept_out is not None:
         accept_out.append(accept)
-    agg_shares = [
-        bm.agg_share_to_host(
-            bm.aggregate(p.out_share, jnp.asarray(accept)))
-        for p in (p0, p1)
-    ]
-    num = int(np.asarray(accept).sum())
+    agg_shares = [bm.agg_share_to_host(a) for a in (agg0, agg1)]
+    num = int(accept.sum())
     return bm.m.unshard(agg_param, agg_shares, num)
 
 
-def _require_ok(p0, p1) -> None:
+def _require_ok(ok) -> None:
     """Rejection sampling fired (~2^-32/element): the scalar fallback
     for affected reports is not wired up yet, so fail loudly rather
     than silently diverge."""
-    if not (bool(np.all(np.asarray(p0.ok)))
-            and bool(np.all(np.asarray(p1.ok)))):
+    if not bool(np.all(np.asarray(ok))):
         raise NotImplementedError(
             "XOF rejection-sampling fallback not yet implemented for "
             "this batch")
@@ -85,12 +94,24 @@ def _require_ok(p0, p1) -> None:
 
 def compute_heavy_hitters(mastic: Mastic, ctx: bytes, thresholds: dict,
                           reports: list,
-                          verify_key: Optional[bytes] = None) -> list:
-    """The full collector loop (reference examples.py:37-91)."""
+                          verify_key: Optional[bytes] = None,
+                          incremental: bool = True) -> list:
+    """The full collector loop (reference examples.py:37-91).
+
+    With `incremental` (the default), each aggregator carries its
+    prefix-tree state across rounds and only evaluates the new level's
+    frontier — O(BITS * frontier) node evaluations for the whole run
+    instead of O(BITS^2 * frontier) — using one compiled round program
+    per padded frontier width (backend/incremental.py).  The
+    `incremental=False` path re-evaluates from the root each round
+    (one compile per level) and serves as the differential reference.
+    """
     if verify_key is None:
         verify_key = gen_rand(mastic.VERIFY_KEY_SIZE)
     bm = BatchedMastic(mastic)
     batch = bm.marshal_reports(reports)
+    runner = (_IncrementalRunner(bm, verify_key, ctx, batch)
+              if incremental else None)
 
     prefixes: list = [(False,), (True,)]
     prev_agg_params: list = []
@@ -100,7 +121,11 @@ def compute_heavy_hitters(mastic: Mastic, ctx: bytes, thresholds: dict,
             break
         agg_param = (level, tuple(prefixes), level == 0)
         assert mastic.is_valid(agg_param, prev_agg_params)
-        agg_result = run_round(bm, verify_key, ctx, agg_param, batch)
+        if runner is not None:
+            agg_result = runner.round(agg_param)
+        else:
+            agg_result = run_round(bm, verify_key, ctx, agg_param,
+                                   batch)
         prev_agg_params.append(agg_param)
 
         survivors = [
@@ -113,3 +138,121 @@ def compute_heavy_hitters(mastic: Mastic, ctx: bytes, thresholds: dict,
         else:
             heavy_hitters = survivors
     return heavy_hitters
+
+
+class _IncrementalRunner:
+    """Drives backend/incremental.py across the collector loop: keeps
+    both aggregators' carries, grows the padded width on demand
+    (recompiling at most log2(max_width) times), and folds the
+    weight-check FLP verdict of the level-0 round in via the fused
+    round program."""
+
+    def __init__(self, bm: BatchedMastic, verify_key: bytes, ctx: bytes,
+                 batch: ReportBatch, width: int = 8):
+        from ..backend.incremental import IncrementalMastic
+
+        self.bm = bm
+        self.verify_key = verify_key
+        self.ctx = ctx
+        self.batch = batch
+        self.num_reports = int(batch.nonces.shape[0])
+        self.width = max(4, width)
+        self.engine = IncrementalMastic(bm, self.width)
+        (self.ext_rk, self.conv_rk) = jax.jit(
+            lambda n: bm.vidpf.roundkeys(ctx, n))(batch.nonces)
+        self.carries = [
+            self.engine.init_carry(self.num_reports,
+                                   batch.keys[:, a], a)
+            for a in range(2)
+        ]
+        self.carried_paths: list = []
+        self.prev_paths = None
+        self._eval_fn = None
+        self._agg_fn = None
+
+    def _grow(self, width: int) -> None:
+        from ..backend.incremental import Carry, IncrementalMastic
+
+        pad_nodes = width - self.width
+        self.carries = [
+            Carry(
+                w=jnp.pad(c.w, ((0, 0), (0, 0), (0, pad_nodes),
+                                (0, 0), (0, 0))),
+                proof=jnp.pad(c.proof,
+                              ((0, 0), (0, 0), (0, pad_nodes), (0, 0))),
+                seed=jnp.pad(c.seed, ((0, 0), (0, pad_nodes), (0, 0))),
+                ctrl=jnp.pad(c.ctrl, ((0, 0), (0, pad_nodes))),
+            )
+            for c in self.carries
+        ]
+        self.width = width
+        self.engine = IncrementalMastic(self.bm, width)
+        self._eval_fn = None
+        self._agg_fn = None
+
+    def _plan(self, prefixes, level):
+        from ..backend.incremental import RoundPlan
+
+        while True:
+            try:
+                return RoundPlan(prefixes, level,
+                                 self.bm.m.vidpf.BITS, self.width,
+                                 self.prev_paths, self.carried_paths)
+            except ValueError as err:
+                if "exceeds padded width" not in str(err):
+                    raise
+                self._grow(self.width * 2)
+
+    def _fns(self):
+        if self._eval_fn is None:
+            engine = self.engine
+            (vk, ctx) = (self.verify_key, self.ctx)
+
+            def both(c0, c1, rnd, ext_rk, conv_rk, cws):
+                (c0, proof0, out0, ok0) = engine.agg_round(
+                    0, vk, ctx, c0, rnd, ext_rk, conv_rk, cws)
+                (c1, proof1, out1, ok1) = engine.agg_round(
+                    1, vk, ctx, c1, rnd, ext_rk, conv_rk, cws)
+                accept = jnp.all(proof0 == proof1, axis=-1)
+                return (c0, c1, out0, out1, accept, ok0 & ok1)
+
+            def agg(out0, out1, accept):
+                return (self.bm.aggregate(out0, accept),
+                        self.bm.aggregate(out1, accept))
+
+            self._eval_fn = jax.jit(both)
+            self._agg_fn = jax.jit(agg)
+        return (self._eval_fn, self._agg_fn)
+
+    def round(self, agg_param) -> list:
+        from ..backend.incremental import round_inputs
+
+        (level, prefixes, do_weight_check) = agg_param
+        plan = self._plan(prefixes, level)
+        (eval_fn, agg_fn) = self._fns()
+        (c0, c1, out0, out1, accept, ok) = eval_fn(
+            self.carries[0], self.carries[1], round_inputs(plan),
+            self.ext_rk, self.conv_rk, self.batch.cws)
+        _require_ok(ok)
+        self.carries = [c0, c1]
+        self.carried_paths = plan.needed
+        self.prev_paths = plan.needed[level]
+
+        if do_weight_check:
+            # The FLP weight check runs through the fused from-root
+            # round program, re-evaluating level 0 (2 nodes wide —
+            # negligible next to the deep levels) to reuse its
+            # query/decide pipeline; its accept is authoritative.
+            (_agg0, _agg1, wc_accept, wc_ok) = _round_fn(
+                self.bm, self.verify_key, self.ctx, agg_param)(
+                self.batch)
+            _require_ok(wc_ok)
+            accept = jnp.asarray(accept) & jnp.asarray(wc_accept)
+
+        (agg0, agg1) = agg_fn(out0, out1, jnp.asarray(accept))
+        rows = len(prefixes) * (1 + self.bm.m.flp.OUTPUT_LEN)
+        agg_shares = [
+            self.bm.agg_share_to_host(a[:rows]) for a in (agg0, agg1)
+        ]
+        num = int(np.asarray(accept).sum())
+        return self.bm.m.unshard(agg_param, agg_shares, num)

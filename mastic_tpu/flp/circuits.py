@@ -47,8 +47,7 @@ class Count(Valid[int, int, F]):
         return output[0].int()
 
     def test_vec_set_type_param(self, test_vec):
-        test_vec["field"] = self.field.__name__
-        return ["field"]
+        return []
 
 
 class Sum(Valid[int, int, F]):
@@ -97,8 +96,7 @@ class Sum(Valid[int, int, F]):
 
     def test_vec_set_type_param(self, test_vec):
         test_vec["max_measurement"] = self.max_measurement
-        test_vec["field"] = self.field.__name__
-        return ["max_measurement", "field"]
+        return ["max_measurement"]
 
 
 class _ParallelSumRangeChecks(Generic[F]):
@@ -177,8 +175,7 @@ class SumVec(_ParallelSumRangeChecks[F], Valid[list[int], list[int], F]):
         test_vec["length"] = self.length
         test_vec["bits"] = self.bits
         test_vec["chunk_length"] = self.chunk_length
-        test_vec["field"] = self.field.__name__
-        return ["length", "bits", "chunk_length", "field"]
+        return ["length", "bits", "chunk_length"]
 
 
 class Histogram(_ParallelSumRangeChecks[F], Valid[int, list[int], F]):
@@ -223,8 +220,7 @@ class Histogram(_ParallelSumRangeChecks[F], Valid[int, list[int], F]):
     def test_vec_set_type_param(self, test_vec):
         test_vec["length"] = self.length
         test_vec["chunk_length"] = self.chunk_length
-        test_vec["field"] = self.field.__name__
-        return ["length", "chunk_length", "field"]
+        return ["length", "chunk_length"]
 
 
 class MultihotCountVec(_ParallelSumRangeChecks[F],
@@ -287,5 +283,4 @@ class MultihotCountVec(_ParallelSumRangeChecks[F],
         test_vec["length"] = self.length
         test_vec["max_weight"] = self.max_weight
         test_vec["chunk_length"] = self.chunk_length
-        test_vec["field"] = self.field.__name__
-        return ["length", "max_weight", "chunk_length", "field"]
+        return ["length", "max_weight", "chunk_length"]

@@ -1,0 +1,327 @@
+"""Batched BBCGGI19 FLP: device-side prove / query / decide.
+
+Device twin of the scalar FlpBBCGGI19 (flp/flp.py, semantics from the
+reference's use of vdaf_poc.flp_bbcggi19 at /root/reference/poc/
+mastic.py:125, :250-256, :349), exact over whole report batches.
+
+The batched design exploits three structural facts of the five Mastic
+circuits (flp/circuits.py):
+
+* every circuit has exactly ONE gadget, of degree 2 — so the gadget
+  polynomial always has 2p-1 coefficients for wire domain size
+  p = next_pow2(calls+1), and its evaluations on the call domain
+  {alpha^k} are even-indexed entries of one size-2p NTT;
+* wire values at the call points are affine-bilinear in the
+  measurement share and joint-rand powers — buildable with one gather
+  plus one elementwise multiply, no per-call loop;
+* the random spot-check point t is per-report, so wire polynomials are
+  interpolated with a batched size-p inverse NTT and Horner-evaluated
+  at t (no per-report field inversions anywhere).
+
+All arithmetic runs in the Montgomery limb domain (ops/field_jax.py);
+plain limbs cross the call boundary, matching the rest of the batched
+backend.  The scalar layer remains the byte-exact arbiter: every path
+here is differentially tested against it (tests/test_flp_jax.py).
+"""
+
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..common import next_power_of_2
+from ..ops.field_jax import FieldSpec, field_sum, spec_for
+from ..ops.ntt_jax import ntt_plan, poly_eval_mont, pow_static, power_chain
+from .circuits import Count, Histogram, MultihotCountVec, Sum, SumVec
+from .flp import FlpBBCGGI19, Mul, ParallelSum, PolyEval
+
+
+class BatchedFlp:
+    """Batched prove/query/decide for one FLP instantiation."""
+
+    def __init__(self, flp: FlpBBCGGI19):
+        self.flp = flp
+        self.spec: FieldSpec = spec_for(flp.field)
+        valid = flp.valid
+        assert len(valid.GADGETS) == 1, "Mastic circuits use one gadget"
+        gadget = valid.GADGETS[0]
+        self.calls = valid.GADGET_CALLS[0]
+        self.arity = gadget.ARITY
+        self.p = next_power_of_2(self.calls + 1)
+        assert gadget.DEGREE == 2, "all five circuits are degree-2"
+        self.coeff_len = 2 * (self.p - 1) + 1
+        self.meas_len = valid.MEAS_LEN
+        self.eval_output_len = valid.EVAL_OUTPUT_LEN
+
+        if isinstance(valid, Count):
+            self.kind = "count"
+            self.gadget_kind = "mul"
+            extra = []
+        elif isinstance(valid, Sum):
+            self.kind = "sum"
+            self.gadget_kind = "polyeval"
+            # range_check = offset*shares_inv + decode(meas[:b])
+            #             - decode(meas[b:])    (circuits.py Sum.eval)
+            bits = valid.bits
+            lin = [1 << i for i in range(bits)] + \
+                [-(1 << i) for i in range(bits)]
+            extra = [(lin, valid.offset.int())]
+        else:
+            self.kind = "chunked"
+            self.gadget_kind = "parallel_mul"
+            assert isinstance(gadget, ParallelSum)
+            self.chunk_length = gadget.count
+            if isinstance(valid, SumVec):
+                extra = []
+            elif isinstance(valid, Histogram):
+                extra = [([1] * self.meas_len, -1)]
+            elif isinstance(valid, MultihotCountVec):
+                lin = [1] * valid.length + \
+                    [-(1 << i) for i in range(valid.bits_for_weight)]
+                extra = [(lin, valid.offset.int())]
+            else:
+                raise ValueError(f"unsupported circuit {type(valid)}")
+        # Extra (non-gadget) output rows: coefficients over meas plus a
+        # constant that scales with shares_inv.
+        self.extra_lin = np.array([row for (row, _) in extra],
+                                  np.int64).reshape(len(extra),
+                                                    self.meas_len)
+        self.extra_const = [c for (_, c) in extra]
+
+        # NTT plans (host-precomputed twiddles; compiled shapes).
+        self.intt_p = ntt_plan(self.spec, self.p, inverse=True)
+        self.ntt_2p = ntt_plan(self.spec, 2 * self.p, inverse=False)
+        self.intt_2p = ntt_plan(self.spec, 2 * self.p, inverse=True)
+
+        if self.kind == "chunked":
+            # meas gather map: chunk k position j -> meas[k*c+j] or the
+            # zero sentinel (index meas_len).
+            c = self.chunk_length
+            idx = np.full((self.calls, c), self.meas_len, np.int32)
+            for k in range(self.calls):
+                for j in range(c):
+                    if k * c + j < self.meas_len:
+                        idx[k, j] = k * c + j
+            self.chunk_idx = idx
+
+    # -- host-side Montgomery constants ----------------------------
+
+    def _mont_const(self, value: int) -> np.ndarray:
+        return self.spec.to_mont_host(value % self.spec.modulus)
+
+    def _shares_inv(self, num_shares: int) -> int:
+        return pow(num_shares, self.spec.modulus - 2, self.spec.modulus)
+
+    # -- wire values at the call points ----------------------------
+
+    def _wires(self, meas: jax.Array, joint_rand: Optional[jax.Array],
+               num_shares: int) -> jax.Array:
+        """Wire values for calls 1..C as (..., arity, p, n) Montgomery
+        limbs with slots 0 and C+1.. zero (the caller installs the wire
+        seeds at slot 0)."""
+        spec = self.spec
+        batch = meas.shape[:-2]
+        n = spec.num_limbs
+        wires = jnp.zeros(batch + (self.arity, self.p, n), jnp.uint32)
+        if self.kind == "count":
+            wires = wires.at[..., 0, 1, :].set(meas[..., 0, :])
+            wires = wires.at[..., 1, 1, :].set(meas[..., 0, :])
+            return wires
+        if self.kind == "sum":
+            wires = wires.at[..., 0, 1:self.calls + 1, :].set(meas)
+            return wires
+        # chunked: wire 2j at call k+1 = r_k^(j+1) * meas[k*c+j],
+        #          wire 2j+1            = meas[k*c+j] - shares_inv
+        assert joint_rand is not None
+        c = self.chunk_length
+        zero = jnp.zeros(batch + (1, n), jnp.uint32)
+        meas_ext = jnp.concatenate([meas, zero], axis=-2)
+        gathered = meas_ext[..., self.chunk_idx, :]   # (..., C, c, n)
+        r_pow = power_chain(spec, joint_rand, c)       # (..., C, c, n)
+        # power_chain stacks powers on axis -2 per element of the C
+        # axis: joint_rand (..., C, n) -> (..., C, c, n) wanted; it
+        # returns (..., c, n) stacked over -2 when given (..., n), so
+        # feed it the C axis as batch.
+        even = spec.mul(r_pow, gathered)
+        shares_inv = jnp.asarray(
+            self._mont_const(self._shares_inv(num_shares)))
+        odd = spec.sub(gathered, jnp.broadcast_to(shares_inv,
+                                                  gathered.shape))
+        pair = jnp.stack([even, odd], axis=-2)         # (..., C, c, 2, n)
+        vals = jnp.moveaxis(pair, -4, -2)              # (..., c, 2, C, n)
+        vals = vals.reshape(batch + (self.arity, self.calls, n))
+        return wires.at[..., 1:self.calls + 1, :].set(vals)
+
+    # -- circuit outputs -------------------------------------------
+
+    def _extra_outputs(self, meas: jax.Array,
+                       num_shares: int) -> Optional[jax.Array]:
+        """The non-gadget output rows: (..., num_extra, n) Montgomery."""
+        if not len(self.extra_const):
+            return None
+        spec = self.spec
+        shares_inv = self._shares_inv(num_shares)
+        rows = []
+        for e in range(len(self.extra_const)):
+            lin = np.stack([
+                self._mont_const(int(v))
+                for v in self.extra_lin[e]
+            ])
+            acc = field_sum(spec, spec.mul(meas, jnp.asarray(lin)),
+                            axis=-2)
+            const = self._mont_const(
+                self.extra_const[e] * shares_inv)
+            rows.append(spec.add(acc, jnp.broadcast_to(
+                jnp.asarray(const), acc.shape)))
+        return jnp.stack(rows, axis=-2)
+
+    def _circuit_value(self, gouts: jax.Array, meas: jax.Array,
+                       weights: Optional[jax.Array],
+                       num_shares: int) -> jax.Array:
+        """Reduce gadget outputs + extra rows to the single circuit
+        value v (random linear combination when EVAL_OUTPUT_LEN > 1)."""
+        spec = self.spec
+        extra = self._extra_outputs(meas, num_shares)
+        if self.kind == "count":
+            return spec.sub(gouts[..., 0, :], meas[..., 0, :])
+        if self.kind == "sum":
+            outs = jnp.concatenate([gouts, extra], axis=-2)
+        elif extra is None:   # SumVec
+            return field_sum(spec, gouts, axis=-2)
+        else:                 # Histogram / MultihotCountVec
+            outs = jnp.concatenate(
+                [field_sum(spec, gouts, axis=-2)[..., None, :], extra],
+                axis=-2)
+        assert weights is not None
+        return field_sum(spec, spec.mul(weights, outs), axis=-2)
+
+    # -- gadget evaluation on the call domain ----------------------
+
+    def _gadget_outputs(self, coeffs: jax.Array) -> jax.Array:
+        """Gadget polynomial (coeffs (..., 2p-1, n)) evaluated at
+        alpha^1..alpha^C: alpha = omega_2p^2, so these are the even
+        indices of the size-2p NTT."""
+        batch = coeffs.shape[:-2]
+        n = coeffs.shape[-1]
+        padded = jnp.concatenate([
+            coeffs,
+            jnp.zeros(batch + (2 * self.p - self.coeff_len, n),
+                      jnp.uint32)
+        ], axis=-2)
+        evals = self.ntt_2p(padded)
+        idx = (2 * np.arange(1, self.calls + 1)).astype(np.int32)
+        return evals[..., idx, :]
+
+    # -- query ------------------------------------------------------
+
+    def query(self, meas: jax.Array, proof: jax.Array,
+              query_rand: jax.Array, joint_rand: Optional[jax.Array],
+              num_shares: int = 2):
+        """Batched Flp.query over plain-limb inputs.
+
+        meas (..., MEAS_LEN, n), proof (..., PROOF_LEN, n), query_rand
+        (..., QUERY_RAND_LEN, n), joint_rand (..., JOINT_RAND_LEN, n)
+        or None.  Returns (verifier (..., VERIFIER_LEN, n) plain limbs,
+        ok (...,) — False where t landed inside the NTT domain, the
+        scalar layer's ValueError case).
+        """
+        spec = self.spec
+        meas = spec.to_mont(meas)
+        proof = spec.to_mont(proof)
+        query_rand = spec.to_mont(query_rand)
+        jr = spec.to_mont(joint_rand) if joint_rand is not None and \
+            joint_rand.shape[-2] else None
+
+        if self.eval_output_len > 1:
+            weights = query_rand[..., :self.eval_output_len, :]
+            t = query_rand[..., self.eval_output_len, :]
+        else:
+            weights = None
+            t = query_rand[..., 0, :]
+
+        seeds = proof[..., :self.arity, :]
+        coeffs = proof[..., self.arity:, :]
+
+        wires = self._wires(meas, jr, num_shares)
+        wires = wires.at[..., 0, :].set(seeds)
+
+        gouts = self._gadget_outputs(coeffs)
+        v = self._circuit_value(gouts, meas, weights, num_shares)
+
+        wire_coeffs = self.intt_p(wires)
+        wire_at_t = poly_eval_mont(spec, wire_coeffs, t[..., None, :])
+        gp_at_t = poly_eval_mont(spec, coeffs, t)
+
+        verifier = jnp.concatenate(
+            [v[..., None, :], wire_at_t, gp_at_t[..., None, :]],
+            axis=-2)
+        one = jnp.asarray(spec.ONE_MONT)
+        ok = ~jnp.all(pow_static(spec, t, self.p) == one, axis=-1)
+        return (spec.from_mont(verifier), ok)
+
+    # -- decide -----------------------------------------------------
+
+    def _gadget_eval(self, x: jax.Array) -> jax.Array:
+        """The bare gadget on Montgomery inputs x (..., arity, n)."""
+        spec = self.spec
+        if self.gadget_kind == "mul":
+            return spec.mul(x[..., 0, :], x[..., 1, :])
+        if self.gadget_kind == "polyeval":
+            # p(z) = z^2 - z  (circuits.py Sum)
+            z = x[..., 0, :]
+            return spec.sub(spec.mul(z, z), z)
+        prod = spec.mul(x[..., 0::2, :], x[..., 1::2, :])
+        return field_sum(spec, prod, axis=-2)
+
+    def decide(self, verifier: jax.Array) -> jax.Array:
+        """Batched Flp.decide over the summed verifier (plain limbs,
+        (..., VERIFIER_LEN, n)) -> bool (...,)."""
+        spec = self.spec
+        v_zero = jnp.all(verifier[..., 0, :] == 0, axis=-1)
+        x = spec.to_mont(verifier[..., 1:1 + self.arity, :])
+        y = spec.to_mont(verifier[..., 1 + self.arity, :])
+        consistent = jnp.all(self._gadget_eval(x) == y, axis=-1)
+        return v_zero & consistent
+
+    # -- prove ------------------------------------------------------
+
+    def prove(self, meas: jax.Array, prove_rand: jax.Array,
+              joint_rand: Optional[jax.Array]) -> jax.Array:
+        """Batched Flp.prove over plain-limb inputs -> proof
+        (..., PROOF_LEN, n) plain limbs."""
+        spec = self.spec
+        meas_m = spec.to_mont(meas)
+        seeds = spec.to_mont(prove_rand)
+        jr = spec.to_mont(joint_rand) if joint_rand is not None and \
+            joint_rand.shape[-2] else None
+
+        wires = self._wires(meas_m, jr, num_shares=1)
+        wires = wires.at[..., 0, :].set(seeds)
+        wire_coeffs = self.intt_p(wires)     # (..., A, p, n)
+
+        batch = wires.shape[:-3]
+        n = spec.num_limbs
+        padded = jnp.concatenate([
+            wire_coeffs,
+            jnp.zeros(batch + (self.arity, self.p, n), jnp.uint32)
+        ], axis=-2)
+        wire_evals = self.ntt_2p(padded)     # (..., A, 2p, n)
+
+        if self.gadget_kind == "mul":
+            gp_evals = spec.mul(wire_evals[..., 0, :, :],
+                                wire_evals[..., 1, :, :])
+        elif self.gadget_kind == "polyeval":
+            z = wire_evals[..., 0, :, :]
+            gp_evals = spec.sub(spec.mul(z, z), z)
+        else:
+            prod = spec.mul(wire_evals[..., 0::2, :, :],
+                            wire_evals[..., 1::2, :, :])
+            gp_evals = field_sum(spec, prod, axis=-3)
+
+        gp_coeffs = self.intt_2p(gp_evals)   # (..., 2p, n)
+        proof = jnp.concatenate(
+            [spec.from_mont(seeds),
+             spec.from_mont(gp_coeffs[..., :self.coeff_len, :])],
+            axis=-2)
+        return proof

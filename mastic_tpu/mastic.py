@@ -9,7 +9,7 @@ tree of mastic_tpu.vidpf so the batched TPU backend
 (mastic_tpu/backend/) can share the exact same schedule.
 """
 
-from typing import Any, Generic, Optional, TypeAlias, TypeVar
+from typing import Generic, Optional, TypeAlias, TypeVar
 
 from .common import (concat, front, pack_bits, to_be_bytes, to_le_bytes,
                      unpack_bits, vec_add, vec_neg, vec_sub)
@@ -90,15 +90,22 @@ class Mastic(
     # -- client (reference mastic.py:91-185) -----------------------
 
     def shard(self, ctx, measurement, nonce, rand):
-        if self.flp.JOINT_RAND_LEN > 0:
-            return self.shard_with_joint_rand(ctx, measurement, nonce, rand)
-        return self.shard_without_joint_rand(ctx, measurement, nonce, rand)
-
-    def shard_without_joint_rand(self, ctx, measurement, nonce, rand):
-        (vidpf_rand, rand) = front(self.vidpf.RAND_SIZE, rand)
-        (prove_rand_seed, rand) = front(self.xof.SEED_SIZE, rand)
-        (helper_seed, rand) = front(self.xof.SEED_SIZE, rand)
-        assert len(rand) == 0
+        """Produce the public share (VIDPF correction words) and the
+        two input shares.  One code path serves both FLP families: for
+        joint-rand circuits the client additionally derives both
+        parties' joint-rand parts itself (it knows both beta shares)
+        and attaches the peer's part to each input share.
+        """
+        use_jr = self.flp.JOINT_RAND_LEN > 0
+        seeds_needed = 3 if use_jr else 2
+        (vidpf_rand, rest) = front(self.vidpf.RAND_SIZE, rand)
+        seeds = []
+        for _ in range(seeds_needed):
+            (seed, rest) = front(self.xof.SEED_SIZE, rest)
+            seeds.append(bytes(seed))
+        assert len(rest) == 0
+        (prove_rand_seed, helper_seed) = seeds[:2]
+        leader_seed = seeds[2] if use_jr else None
 
         # beta = counter || encoded weight.
         (alpha, weight) = measurement
@@ -107,53 +114,30 @@ class Mastic(
         (correction_words, keys) = \
             self.vidpf.gen(alpha, beta, ctx, nonce, vidpf_rand)
 
-        prove_rand = self.prove_rand(ctx, prove_rand_seed)
-        proof = self.flp.prove(beta[1:], prove_rand, [])
-        helper_proof_share = self.helper_proof_share(ctx, helper_seed)
-        leader_proof_share = vec_sub(proof, helper_proof_share)
+        joint_rand: list[F] = []
+        parts = None
+        if use_jr:
+            # Each party contributes a part bound to its beta share;
+            # the client evaluates both shares to compute both parts.
+            parts = []
+            for (agg_id, seed) in ((0, leader_seed), (1, helper_seed)):
+                beta_share = self.vidpf.get_beta_share(
+                    agg_id, correction_words, keys[agg_id], ctx, nonce)
+                parts.append(self.joint_rand_part(
+                    ctx, seed, beta_share[1:], nonce))
+            joint_rand = self.joint_rand(
+                ctx, self.joint_rand_seed(ctx, parts))
+
+        proof = self.flp.prove(beta[1:],
+                               self.prove_rand(ctx, prove_rand_seed),
+                               joint_rand)
+        leader_proof_share = vec_sub(
+            proof, self.helper_proof_share(ctx, helper_seed))
 
         input_shares: list[MasticInputShare] = [
-            (keys[0], leader_proof_share, None, None),
-            (keys[1], None, helper_seed, None),
-        ]
-        return (correction_words, input_shares)
-
-    def shard_with_joint_rand(self, ctx, measurement, nonce, rand):
-        (vidpf_rand, rand) = front(self.vidpf.RAND_SIZE, rand)
-        (prove_rand_seed, rand) = front(self.xof.SEED_SIZE, rand)
-        (helper_seed, rand) = front(self.xof.SEED_SIZE, rand)
-        (leader_seed, rand) = front(self.xof.SEED_SIZE, rand)
-        assert len(rand) == 0
-
-        (alpha, weight) = measurement
-        beta = [self.field(1)] + self.flp.encode(weight)
-
-        (correction_words, keys) = \
-            self.vidpf.gen(alpha, beta, ctx, nonce, vidpf_rand)
-
-        # Joint randomness: each party contributes a part bound to its
-        # share of beta; the client can compute both parts itself.
-        leader_beta_share = self.vidpf.get_beta_share(
-            0, correction_words, keys[0], ctx, nonce)
-        helper_beta_share = self.vidpf.get_beta_share(
-            1, correction_words, keys[1], ctx, nonce)
-        joint_rand_parts = [
-            self.joint_rand_part(ctx, leader_seed, leader_beta_share[1:],
-                                 nonce),
-            self.joint_rand_part(ctx, helper_seed, helper_beta_share[1:],
-                                 nonce),
-        ]
-        joint_rand = self.joint_rand(
-            ctx, self.joint_rand_seed(ctx, joint_rand_parts))
-
-        prove_rand = self.prove_rand(ctx, prove_rand_seed)
-        proof = self.flp.prove(beta[1:], prove_rand, joint_rand)
-        helper_proof_share = self.helper_proof_share(ctx, helper_seed)
-        leader_proof_share = vec_sub(proof, helper_proof_share)
-
-        input_shares: list[MasticInputShare] = [
-            (keys[0], leader_proof_share, leader_seed, joint_rand_parts[1]),
-            (keys[1], None, helper_seed, joint_rand_parts[0]),
+            (keys[0], leader_proof_share, leader_seed,
+             parts[1] if parts else None),
+            (keys[1], None, helper_seed, parts[0] if parts else None),
         ]
         return (correction_words, input_shares)
 
@@ -389,16 +373,38 @@ class Mastic(
         return (key, proof_share, seed, peer_joint_rand_part)
 
     # -- XOF derivations (reference mastic.py:452-510) -------------
+    #
+    # Every per-protocol random vector is one row of this table: the
+    # XOF usage plus which FLP length it expands to.  The seed and
+    # binder vary per row and are supplied by the caller.
 
-    def helper_proof_share(self, ctx: bytes, seed: bytes) -> list[F]:
+    _VEC_DERIVATIONS = {
+        "prove_rand": (USAGE_PROVE_RAND, "PROVE_RAND_LEN"),
+        "proof_share": (USAGE_PROOF_SHARE, "PROOF_LEN"),
+        "joint_rand": (USAGE_JOINT_RAND, "JOINT_RAND_LEN"),
+        "query_rand": (USAGE_QUERY_RAND, "QUERY_RAND_LEN"),
+    }
+
+    def derive_vec(self, what: str, ctx: bytes, seed: bytes,
+                   binder: bytes = b"") -> list[F]:
+        (usage, length_attr) = self._VEC_DERIVATIONS[what]
         return self.xof.expand_into_vec(
-            self.field, seed, dst_alg(ctx, USAGE_PROOF_SHARE, self.ID),
-            b"", self.flp.PROOF_LEN)
+            self.field, seed, dst_alg(ctx, usage, self.ID), binder,
+            getattr(self.flp, length_attr))
 
     def prove_rand(self, ctx: bytes, seed: bytes) -> list[F]:
-        return self.xof.expand_into_vec(
-            self.field, seed, dst_alg(ctx, USAGE_PROVE_RAND, self.ID),
-            b"", self.flp.PROVE_RAND_LEN)
+        return self.derive_vec("prove_rand", ctx, seed)
+
+    def helper_proof_share(self, ctx: bytes, seed: bytes) -> list[F]:
+        return self.derive_vec("proof_share", ctx, seed)
+
+    def joint_rand(self, ctx: bytes, seed: bytes) -> list[F]:
+        return self.derive_vec("joint_rand", ctx, seed)
+
+    def query_rand(self, verify_key: bytes, ctx: bytes, nonce: bytes,
+                   level: int) -> list[F]:
+        return self.derive_vec("query_rand", ctx, verify_key,
+                               nonce + to_le_bytes(level, 2))
 
     def joint_rand_part(self, ctx: bytes, seed: bytes,
                         weight_share: list[F], nonce: bytes) -> bytes:
@@ -410,64 +416,6 @@ class Mastic(
         return self.xof.derive_seed(
             b"", dst_alg(ctx, USAGE_JOINT_RAND_SEED, self.ID),
             concat(parts))
-
-    def joint_rand(self, ctx: bytes, seed: bytes) -> list[F]:
-        return self.xof.expand_into_vec(
-            self.field, seed, dst_alg(ctx, USAGE_JOINT_RAND, self.ID),
-            b"", self.flp.JOINT_RAND_LEN)
-
-    def query_rand(self, verify_key: bytes, ctx: bytes, nonce: bytes,
-                   level: int) -> list[F]:
-        return self.xof.expand_into_vec(
-            self.field, verify_key, dst_alg(ctx, USAGE_QUERY_RAND, self.ID),
-            nonce + to_le_bytes(level, 2), self.flp.QUERY_RAND_LEN)
-
-    # -- test-vector encoders (reference mastic.py:512-559) --------
-
-    def test_vec_set_type_param(self, test_vec: dict[str, Any]) -> list[str]:
-        test_vec["vidpf_bits"] = int(self.vidpf.BITS)
-        return ["vidpf_bits"] + self.flp.test_vec_set_type_param(test_vec)
-
-    def test_vec_encode_input_share(self,
-                                    input_share: MasticInputShare) -> bytes:
-        (key, proof_share, seed, peer_joint_rand_part) = input_share
-        encoded = bytes()
-        encoded += key
-        if proof_share is not None:
-            encoded += self.field.encode_vec(proof_share)
-        if seed is not None:
-            encoded += seed
-        if peer_joint_rand_part is not None:
-            encoded += peer_joint_rand_part
-        return encoded
-
-    def test_vec_encode_public_share(
-            self, correction_words: list[CorrectionWord]) -> bytes:
-        return self.vidpf.encode_public_share(correction_words)
-
-    def test_vec_encode_agg_share(self, agg_share: list[F]) -> bytes:
-        encoded = bytes()
-        if len(agg_share) > 0:
-            encoded += self.field.encode_vec(agg_share)
-        return encoded
-
-    def test_vec_encode_prep_share(self,
-                                   prep_share: MasticPrepShare) -> bytes:
-        (eval_proof, verifier_share, joint_rand_part) = prep_share
-        encoded = bytes()
-        encoded += eval_proof
-        if joint_rand_part is not None:
-            encoded += joint_rand_part
-        if verifier_share is not None:
-            encoded += self.field.encode_vec(verifier_share)
-        return encoded
-
-    def test_vec_encode_prep_msg(self,
-                                 prep_message: MasticPrepMessage) -> bytes:
-        encoded = bytes()
-        if prep_message is not None:
-            encoded += prep_message
-        return encoded
 
 
 ##

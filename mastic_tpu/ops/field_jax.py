@@ -43,6 +43,16 @@ class FieldSpec:
         self.P = self.int_to_limbs(self.modulus)
         self.R2_LIMBS = self.int_to_limbs(self.R2)
         self.ONE_MONT = self.int_to_limbs(self.R % self.modulus)
+        # One-hot (n, n, 2n+1) tensors scattering partial product (i, j)
+        # into column i+j (low halves) / i+j+1 (high halves): the
+        # schoolbook column sum becomes one einsum, which traces O(1)
+        # ops and lets XLA tile it instead of compiling n^2 scatters.
+        self.COL_LO = np.zeros((n, n, 2 * n + 1), np.uint32)
+        self.COL_HI = np.zeros((n, n, 2 * n + 1), np.uint32)
+        for i in range(n):
+            for j in range(n):
+                self.COL_LO[i, j, i + j] = 1
+                self.COL_HI[i, j, i + j + 1] = 1
 
     # -- host-side converters (Python bignum; for constants & tests) --
 
@@ -125,32 +135,40 @@ class FieldSpec:
     def mul(self, a: jax.Array, b: jax.Array) -> jax.Array:
         """Montgomery product: mont(x)*mont(y) -> mont(x*y)."""
         n = self.num_limbs
-        batch = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
-        # Schoolbook product into 2n+1 columns.
+        # Schoolbook product into 2n+1 columns via one einsum per half
+        # (column sums stay < 2n * 2^16 < 2^32).
         prods = a[..., :, None] * b[..., None, :]
-        lo = prods & _MASK16
-        hi = prods >> 16
-        cols = jnp.zeros(batch + (2 * n + 1,), _U32)
-        for i in range(n):
-            for j in range(n):
-                cols = cols.at[..., i + j].add(lo[..., i, j])
-                cols = cols.at[..., i + j + 1].add(hi[..., i, j])
-        t = self._propagate(cols, 2 * n + 1)
+        cols = jnp.einsum("...ij,ijk->...k", prods & _MASK16,
+                          jnp.asarray(self.COL_LO)) + \
+            jnp.einsum("...ij,ijk->...k", prods >> 16,
+                       jnp.asarray(self.COL_HI))
+        # REDC: clear the low n limbs one at a time, deferring all
+        # carry propagation except the single carry out of the limb
+        # being cleared (the quotient digit m only needs t[i] exact
+        # mod 2^16, and every contribution to column i has landed by
+        # iteration i).  Columns stay < 2^22, far from uint32 overflow.
+        # The chain runs under lax.scan so its body compiles once per
+        # call site — XLA-CPU compile time of the unrolled form
+        # dominated the whole test suite.
+        p_arr = jnp.asarray(self.P)
 
-        # REDC: clear the low n limbs one at a time.
-        for i in range(n):
-            m = (t[..., i] * _U32(self.P_PRIME)) & _MASK16
-            mp_lo = (m[..., None] * jnp.asarray(self.P)) & _MASK16
-            mp_hi = (m[..., None] * jnp.asarray(self.P)) >> 16
-            t = t.at[..., i:i + n].add(mp_lo)
-            t = t.at[..., i + 1:i + n + 1].add(mp_hi)
-            # Propagate the (now zero mod 2^16) limb i upward; later
-            # limbs stay bounded because each step adds < 2^17 carries.
-            t = jnp.concatenate([
-                t[..., :i],
-                self._propagate(t[..., i:], 2 * n + 1 - i),
-            ], axis=-1)
-        return self._cond_sub_p(t[..., n:])
+        def clear_limb(t, i):
+            digit = jax.lax.dynamic_index_in_dim(t, i, axis=-1,
+                                                 keepdims=False)
+            m = (digit * _U32(self.P_PRIME)) & _MASK16
+            mp = m[..., None] * p_arr
+            window = jax.lax.dynamic_slice_in_dim(t, i, n + 1, axis=-1)
+            window = window.at[..., :n].add(mp & _MASK16)
+            window = window.at[..., 1:].add(mp >> 16)
+            # Forward the cleared limb's carry one column.
+            window = window.at[..., 1].add(window[..., 0] >> 16)
+            return (jax.lax.dynamic_update_slice_in_dim(
+                t, window, i, axis=-1), None)
+
+        (t, _) = jax.lax.scan(clear_limb, cols,
+                              jnp.arange(n, dtype=jnp.int32))
+        out = self._propagate(t[..., n:], n + 1)
+        return self._cond_sub_p(out)
 
     def to_mont(self, plain: jax.Array) -> jax.Array:
         return self.mul(plain, jnp.asarray(self.R2_LIMBS))

@@ -127,6 +127,77 @@ def lanes_to_bytes(lo: jax.Array, hi: jax.Array) -> jax.Array:
     return by.reshape(by.shape[:-3] + (-1,)).astype(jnp.uint8)
 
 
+def turbo_shake128_dynamic(msg: jax.Array, length: jax.Array,
+                           domain: int, out_len: int,
+                           num_rounds: int = 12) -> jax.Array:
+    """TurboSHAKE128 over a runtime-length prefix of `msg`.
+
+    msg: uint8 (..., max_len) — bytes at positions >= `length` are
+    ignored (masked to zero before padding).  `length` is a traced
+    int32 scalar shared by the whole batch (in Mastic every
+    runtime-varying message length is public protocol data, identical
+    across reports).  Byte-exact vs turbo_shake128(msg[..., :length])
+    for every length in [0, max_len].
+
+    The absorb loop is a lax.while_loop over blocks, so the compiled
+    program serves any length up to max_len and the runtime cost
+    scales with the actual number of blocks, not the capacity.
+    """
+    assert 0x01 <= domain <= 0x7F
+    length = jnp.asarray(length, jnp.int32)
+    max_len = msg.shape[-1]
+    batch_shape = msg.shape[:-1]
+    max_blocks = max_len // RATE + 1
+    total = max_blocks * RATE
+
+    buf = jnp.zeros(batch_shape + (total,), jnp.uint8)
+    buf = buf.at[..., :max_len].set(msg)
+    pos = jnp.arange(total, dtype=jnp.int32)
+    # pad10*1: zero the tail, fold the domain byte in at `length`, set
+    # the top bit of the final byte of the last (padded) block.
+    buf = jnp.where(pos < length, buf, 0)
+    buf = buf ^ jnp.where(pos == length, jnp.uint8(domain),
+                          jnp.uint8(0))
+    num_blocks = length // RATE + 1
+    buf = buf ^ jnp.where(pos == num_blocks * RATE - 1, jnp.uint8(0x80),
+                          jnp.uint8(0))
+
+    blocks = buf.reshape(batch_shape + (max_blocks, RATE))
+    (mlo, mhi) = bytes_to_lanes(blocks)  # (..., max_blocks, 21)
+
+    def cond(carry):
+        (i, _lo, _hi) = carry
+        return i < num_blocks
+
+    def step(carry):
+        (i, lo, hi) = carry
+        blo = jnp.take_along_axis(
+            mlo, jnp.full(batch_shape + (1, 1), i), axis=-2)[..., 0, :]
+        bhi = jnp.take_along_axis(
+            mhi, jnp.full(batch_shape + (1, 1), i), axis=-2)[..., 0, :]
+        lo = lo.at[..., :21].set(lo[..., :21] ^ blo)
+        hi = hi.at[..., :21].set(hi[..., :21] ^ bhi)
+        (lo, hi) = keccak_p1600(lo, hi, num_rounds)
+        return (i + 1, lo, hi)
+
+    lo = jnp.zeros(batch_shape + (25,), _U32)
+    hi = jnp.zeros(batch_shape + (25,), _U32)
+    (_, lo, hi) = jax.lax.while_loop(
+        cond, step, (jnp.int32(0), lo, hi))
+
+    if out_len == 0:
+        return jnp.zeros(batch_shape + (0,), jnp.uint8)
+    out = []
+    produced = 0
+    while produced < out_len:
+        if produced > 0:
+            (lo, hi) = keccak_p1600(lo, hi, num_rounds)
+        out.append(lanes_to_bytes(lo[..., :21], hi[..., :21]))
+        produced += RATE
+    full = jnp.concatenate(out, axis=-1) if len(out) > 1 else out[0]
+    return full[..., :out_len]
+
+
 def _pad_message(msg: jax.Array, domain: int) -> jax.Array:
     """pad10*1 with the domain byte folded in (scalar reference:
     Sponge.finalize, mastic_tpu/keccak.py:126-134)."""

@@ -1,0 +1,145 @@
+"""Batched number-theoretic transforms over the 16-bit-limb Montgomery
+representation (ops/field_jax.py).
+
+The FLP's polynomial algebra (wire interpolation, gadget-polynomial
+evaluation over the call domain — reference semantics:
+/root/reference/poc/mastic.py:250-256 via vdaf_poc.flp_bbcggi19) only
+ever needs transforms of a *static, small* power-of-two size p (the
+gadget wire domain, p = next_pow2(calls+1); p <= 64 for every shipped
+instantiation).  So each transform is an unrolled iterative radix-2
+butterfly network with host-precomputed Montgomery-domain twiddles —
+log2(p) stages of vectorized add/sub/mul over (..., p, limbs) arrays,
+compiled once per (field, size).
+
+Both Field64 (2-adicity 32) and Field128 (2-adicity 66) admit every
+size used here.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .field_jax import FieldSpec
+
+
+def _bit_reverse_perm(size: int) -> np.ndarray:
+    bits = size.bit_length() - 1
+    out = np.zeros(size, np.int32)
+    for i in range(size):
+        out[i] = int(f"{i:0{bits}b}"[::-1], 2) if bits else 0
+    return out
+
+
+class NttPlan:
+    """One compiled-shape transform: out[j] = sum_k x[k] omega^(jk),
+    with omega the canonical generator of the order-`size` subgroup
+    (forward) or its inverse with the 1/size factor folded in
+    (inverse) — matching the scalar poly_eval_domain / poly_interp
+    (mastic_tpu/field.py:164-199)."""
+
+    def __init__(self, spec: FieldSpec, size: int, inverse: bool):
+        assert size & (size - 1) == 0 and size >= 1
+        self.spec = spec
+        self.size = size
+        self.inverse = inverse
+        mod = spec.modulus
+        gen = pow(7, (mod - 1) // spec.gen_order, mod)
+        omega = pow(gen, spec.gen_order // size, mod)
+        if inverse:
+            omega = pow(omega, mod - 2, mod)
+        self.perm = _bit_reverse_perm(size)
+        # Stage s (m = 2^s halves): twiddles omega^(j * size / (2m)).
+        self.stage_twiddles = []
+        m = 1
+        while m < size:
+            step = size // (2 * m)
+            tw = np.stack([
+                spec.to_mont_host(pow(omega, j * step, mod))
+                for j in range(m)
+            ])
+            self.stage_twiddles.append(tw)
+            m *= 2
+        self.size_inv = spec.to_mont_host(
+            pow(size, mod - 2, mod)) if inverse else None
+
+    def __call__(self, x: jax.Array) -> jax.Array:
+        """Transform (..., size, n) Montgomery limbs along axis -2."""
+        spec = self.spec
+        assert x.shape[-2] == self.size
+        x = x[..., self.perm, :]
+        m = 1
+        for tw in self.stage_twiddles:
+            shape = x.shape[:-2] + (self.size // (2 * m), 2 * m,
+                                    x.shape[-1])
+            x = x.reshape(shape)
+            even = x[..., :m, :]
+            odd = spec.mul(x[..., m:, :], jnp.asarray(tw))
+            x = jnp.concatenate(
+                [spec.add(even, odd), spec.sub(even, odd)], axis=-2)
+            x = x.reshape(x.shape[:-3] + (-1, x.shape[-1]))
+            m *= 2
+        if self.size_inv is not None:
+            x = spec.mul(x, jnp.asarray(self.size_inv))
+        return x
+
+
+_PLANS: dict[tuple[int, int, bool], NttPlan] = {}
+
+
+def ntt_plan(spec: FieldSpec, size: int, inverse: bool) -> NttPlan:
+    key = (spec.modulus, size, inverse)
+    plan = _PLANS.get(key)
+    if plan is None:
+        plan = NttPlan(spec, size, inverse)
+        _PLANS[key] = plan
+    return plan
+
+
+def poly_eval_mont(spec: FieldSpec, coeffs: jax.Array,
+                   t: jax.Array) -> jax.Array:
+    """Horner evaluation: coeffs (..., L, n) low-to-high Montgomery,
+    t (..., n) Montgomery -> (..., n).  The chain runs under lax.scan
+    so the (mul, add) body compiles once per call site."""
+    length = coeffs.shape[-2]
+    if length == 1:
+        return coeffs[..., 0, :]
+    t_b = jnp.broadcast_to(t, coeffs.shape[:-2] + t.shape[-1:])
+
+    def body(acc, c):
+        return (spec.add(spec.mul(acc, t_b), c), None)
+
+    rest = jnp.moveaxis(coeffs[..., :length - 1, :], -2, 0)
+    (acc, _) = jax.lax.scan(body, coeffs[..., length - 1, :],
+                            rest, reverse=True)
+    return acc
+
+
+def pow_static(spec: FieldSpec, t: jax.Array, exponent: int) -> jax.Array:
+    """t^exponent for a static exponent (square-and-multiply)."""
+    assert exponent >= 1
+    acc = None
+    base = t
+    e = exponent
+    while e:
+        if e & 1:
+            acc = base if acc is None else spec.mul(acc, base)
+        e >>= 1
+        if e:
+            base = spec.mul(base, base)
+    return acc
+
+
+def power_chain(spec: FieldSpec, t: jax.Array, count: int) -> jax.Array:
+    """[t^1, t^2, ..., t^count] stacked on a new axis -2 (lax.scan so
+    the multiply body compiles once)."""
+    if count == 1:
+        return t[..., None, :]
+
+    def body(acc, _):
+        nxt = spec.mul(acc, t)
+        return (nxt, nxt)
+
+    (_, rest) = jax.lax.scan(body, t, None, length=count - 1)
+    # scan stacks on axis 0; move it next to the limb axis.
+    rest = jnp.moveaxis(rest, 0, -2)
+    return jnp.concatenate([t[..., None, :], rest], axis=-2)

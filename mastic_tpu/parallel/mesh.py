@@ -94,34 +94,21 @@ def sharded_prep_fn(bm: BatchedMastic, mesh: Mesh, agg_id: int,
 
 def sharded_round_fn(bm: BatchedMastic, mesh: Mesh, verify_key: bytes,
                      ctx: bytes, agg_param):
-    """Jit a full two-party simulated round (no weight check): both
-    preps, the on-device eval-proof comparison, and the masked
-    aggregation whose sum over the sharded report axis lowers to an
-    all-reduce (psum) across chips.
+    """Jit a full two-party simulated round over the mesh: both preps,
+    every check — including the device FLP query/decide on
+    weight-check rounds — and the masked aggregation whose sum over the
+    sharded report axis lowers to an all-reduce (psum) across chips.
 
-    Weight-check rounds additionally exchange FLP verifier shares —
-    driven by the host (drivers/heavy_hitters.py), since that exchange
-    crosses the aggregator trust boundary anyway.
-
-    Returns fn(nonces, cws, keys0, keys1)
+    Returns fn(batch: ReportBatch)
     -> (agg_share0, agg_share1, accept, ok).
     """
-    (_level, _prefixes, do_weight_check) = agg_param
-    if do_weight_check:
-        raise ValueError("fully-fused rounds require "
-                         "do_weight_check=False")
     rep = NamedSharding(mesh, P("reports"))
     out_rep = NamedSharding(mesh, P())
 
-    def fn(nonces, cws, keys0, keys1):
-        nonces = jax.lax.with_sharding_constraint(nonces, rep)
-        p0 = bm.prep(0, verify_key, ctx, agg_param, nonces, cws, keys0)
-        p1 = bm.prep(1, verify_key, ctx, agg_param, nonces, cws, keys1)
-        accept = jnp.all(p0.eval_proof == p1.eval_proof, axis=-1)
-        ok = p0.ok & p1.ok
-        agg0 = bm.aggregate(p0.out_share, accept)
-        agg1 = bm.aggregate(p1.out_share, accept)
-        return (agg0, agg1, accept, ok)
+    def fn(batch):
+        batch = batch._replace(
+            nonces=jax.lax.with_sharding_constraint(batch.nonces, rep))
+        return bm.round_device(verify_key, ctx, agg_param, batch)
 
     return jax.jit(fn, out_shardings=(out_rep, out_rep,
                                       NamedSharding(mesh, P("reports")),

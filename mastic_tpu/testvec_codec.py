@@ -1,0 +1,54 @@
+"""Test-vector JSON codec: hex encodings of the wire messages in the
+schema of /root/reference/test_vec/mastic/*.json.
+
+These encoders live outside the protocol class on purpose — they are
+a test-harness concern (the upstream analog is the vdaf_poc test_utils
+machinery, not the VDAF itself), and only the conformance suite and
+the vector generator consume them.
+"""
+
+from typing import Any
+
+from .mastic import (Mastic, MasticInputShare, MasticPrepMessage,
+                     MasticPrepShare)
+from .vidpf import CorrectionWord
+
+
+def set_type_param(mastic: Mastic, test_vec: dict[str, Any]) -> list[str]:
+    test_vec["vidpf_bits"] = int(mastic.vidpf.BITS)
+    return ["vidpf_bits"] + \
+        mastic.flp.valid.test_vec_set_type_param(test_vec)
+
+
+def encode_input_share(mastic: Mastic,
+                       input_share: MasticInputShare) -> bytes:
+    (key, proof_share, seed, peer_joint_rand_part) = input_share
+    optional = [
+        mastic.field.encode_vec(proof_share)
+        if proof_share is not None else b"",
+        seed or b"",
+        peer_joint_rand_part or b"",
+    ]
+    return key + b"".join(optional)
+
+
+def encode_public_share(mastic: Mastic,
+                        correction_words: list[CorrectionWord]) -> bytes:
+    return mastic.vidpf.encode_public_share(correction_words)
+
+
+def encode_agg_share(mastic: Mastic, agg_share: list) -> bytes:
+    return mastic.field.encode_vec(agg_share) if agg_share else b""
+
+
+def encode_prep_share(mastic: Mastic,
+                      prep_share: MasticPrepShare) -> bytes:
+    (eval_proof, verifier_share, joint_rand_part) = prep_share
+    return eval_proof + (joint_rand_part or b"") + (
+        mastic.field.encode_vec(verifier_share)
+        if verifier_share is not None else b"")
+
+
+def encode_prep_msg(mastic: Mastic,
+                    prep_message: MasticPrepMessage) -> bytes:
+    return prep_message or b""

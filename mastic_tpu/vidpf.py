@@ -385,5 +385,13 @@ class Vidpf(Generic[F]):
             (value >> (length - 1 - i)) & 1 != 0 for i in range(length))
 
     def prefixes_for_level(self, level: int) -> tuple[Path, ...]:
+        """Every (level+1)-bit prefix, in lexicographic order.
+
+        Deliberate divergence from the reference helper
+        (vidpf.py:424-427), which enumerates only range(2**level) —
+        the half of the prefixes whose leading bit is 0.  Tests here
+        exercise on-path nodes for arbitrary alphas, so the full
+        2**(level+1) enumeration is required.
+        """
         return tuple(self.test_index_from_int(v, level + 1)
-                     for v in range(2 ** level))
+                     for v in range(2 ** (level + 1)))

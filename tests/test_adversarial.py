@@ -1,0 +1,236 @@
+"""Adversarial / malformed-report tests: every verifiability check
+observed rejecting, on both the scalar and batched paths.
+
+Port of the reference's malformed-input matrix
+(/root/reference/poc/tests/test_vidpf.py:193-341 and
+tests/test_mastic.py:71-175) to this codebase's level-synchronous
+execution model: tamper a VIDPF key, a correction word's
+seed/ctrl/proof, or a payload (counter and weight, including the
+level-0 payload-check-has-no-parent edge case), or the FLP proof /
+joint-rand part — and require prep to reject from the malformed level
+onward while still accepting below it.
+"""
+
+import numpy as np
+import pytest
+
+from mastic_tpu import MasticCount, MasticHistogram
+from mastic_tpu.backend.mastic_jax import BatchedMastic
+from mastic_tpu.common import gen_rand
+
+BITS = 5
+CTX = b"adversarial test"
+
+
+def _make_report(mastic, seed=0):
+    rng = np.random.default_rng(seed)
+    nonce = rng.integers(0, 256, 16, dtype=np.uint8).tobytes()
+    rand = rng.integers(0, 256, mastic.RAND_SIZE,
+                        dtype=np.uint8).tobytes()
+    alpha = (True,) * mastic.vidpf.BITS
+    meas = (alpha, 1) if isinstance(mastic, MasticCount) else (alpha, 0)
+    (public_share, input_shares) = mastic.shard(CTX, meas, nonce, rand)
+    return (nonce, public_share, input_shares)
+
+
+def _scalar_accepts(mastic, nonce, public_share, input_shares,
+                    agg_param, verify_key=bytes(range(32))):
+    """Run both preps + the exchange; True iff the report survives
+    every check (incl. joint-rand confirmation)."""
+    states = []
+    shares = []
+    for agg_id in range(2):
+        (state, share) = mastic.prep_init(
+            verify_key, CTX, agg_id, agg_param, nonce, public_share,
+            input_shares[agg_id])
+        states.append(state)
+        shares.append(share)
+    try:
+        prep_msg = mastic.prep_shares_to_prep(CTX, agg_param, shares)
+        for agg_id in range(2):
+            mastic.prep_next(CTX, states[agg_id], prep_msg)
+    except Exception:
+        return False
+    return True
+
+
+def _batched_accepts(mastic, nonce, public_share, input_shares,
+                     agg_param, verify_key=bytes(range(32))):
+    import jax
+
+    bm = BatchedMastic(mastic)
+    batch = bm.marshal_reports([(nonce, public_share, input_shares)])
+    (_agg0, _agg1, accept, ok) = jax.jit(
+        lambda b: bm.round_device(verify_key, CTX, agg_param, b))(batch)
+    assert bool(np.asarray(ok).all())
+    return bool(np.asarray(accept)[0])
+
+
+def _full_level_param(mastic, level, weight_check=False):
+    return (level, mastic.vidpf.prefixes_for_level(level), weight_check)
+
+
+def _tamper_cw(public_share, level, what):
+    """Copy of the public share with one field of the level's
+    correction word tweaked."""
+    cws = list(public_share)
+    (seed, ctrl, w, proof) = cws[level]
+    if what == "seed":
+        seed = bytes([seed[0] ^ 1]) + seed[1:]
+    elif what == "ctrl":
+        ctrl = [not ctrl[0], ctrl[1]]
+    elif what == "proof":
+        proof = bytes([proof[0] ^ 1]) + proof[1:]
+    elif what == "counter":
+        w = [w[0] + type(w[0])(1)] + list(w[1:])
+    elif what == "weight":
+        w = [w[0]] + [w[1] + type(w[1])(1)] + list(w[2:])
+    else:
+        raise ValueError(what)
+    cws[level] = (seed, ctrl, w, proof)
+    return cws
+
+
+def test_malformed_key():
+    """A tweaked VIDPF key fails verification at every level, on both
+    paths (reference test_vidpf.py:193-221)."""
+    mastic = MasticCount(BITS)
+    (nonce, public_share, input_shares) = _make_report(mastic)
+    (key, proofs, seed, part) = input_shares[0]
+    bad_key = bytes([key[0] ^ 1]) + key[1:]
+    bad_shares = [(bad_key, proofs, seed, part), input_shares[1]]
+    for level in range(BITS):
+        param = _full_level_param(mastic, level)
+        assert not _scalar_accepts(mastic, nonce, public_share,
+                                   bad_shares, param), level
+    assert not _batched_accepts(mastic, nonce, public_share, bad_shares,
+                                _full_level_param(mastic, 2))
+
+
+@pytest.mark.parametrize("what", ["seed", "ctrl", "proof"])
+@pytest.mark.parametrize("malformed_level", [0, 2, BITS - 1])
+def test_malformed_correction_word(what, malformed_level):
+    """A tweaked correction-word seed/ctrl/proof is undetectable below
+    the malformed level and rejected from it onward (reference
+    test_vidpf.py:223-341; the on-path prefix is always in the full
+    level set, so the proof tweak is always caught)."""
+    mastic = MasticCount(BITS)
+    (nonce, public_share, input_shares) = _make_report(mastic)
+    bad = _tamper_cw(public_share, malformed_level, what)
+    for level in range(BITS):
+        param = _full_level_param(mastic, level)
+        accepted = _scalar_accepts(mastic, nonce, bad, input_shares,
+                                   param)
+        assert accepted == (level < malformed_level), (what, level)
+    # Batched spot checks: one level below (accept), one at/above
+    # (reject).
+    if malformed_level > 0:
+        assert _batched_accepts(
+            mastic, nonce, bad, input_shares,
+            _full_level_param(mastic, malformed_level - 1))
+    assert not _batched_accepts(
+        mastic, nonce, bad, input_shares,
+        _full_level_param(mastic, malformed_level))
+
+
+@pytest.mark.parametrize("malformed_level", [0, 1, 3])
+def test_malformed_payload_counter(malformed_level):
+    """Tweaking a payload counter trips the counter check (level 0) or
+    the payload check (deeper) from the malformed level onward
+    (reference test_mastic.py:125-144)."""
+    mastic = MasticCount(BITS)
+    (nonce, public_share, input_shares) = _make_report(mastic)
+    bad = _tamper_cw(public_share, malformed_level, "counter")
+    for level in range(BITS):
+        param = _full_level_param(mastic, level)
+        accepted = _scalar_accepts(mastic, nonce, bad, input_shares,
+                                   param)
+        assert accepted == (level < malformed_level), level
+    assert not _batched_accepts(
+        mastic, nonce, bad, input_shares,
+        _full_level_param(mastic, malformed_level))
+
+
+@pytest.mark.parametrize("malformed_level", [0, 1, 3])
+def test_malformed_payload_weight(malformed_level):
+    """Tweaking a payload weight trips the payload check — except at
+    level 0, where the payload check has no parent and detection is
+    deferred to level 1 (reference test_mastic.py:146-175)."""
+    mastic = MasticCount(BITS)
+    (nonce, public_share, input_shares) = _make_report(mastic)
+    bad = _tamper_cw(public_share, malformed_level, "weight")
+    start = max(malformed_level, 1)
+    for level in range(BITS):
+        param = _full_level_param(mastic, level)
+        accepted = _scalar_accepts(mastic, nonce, bad, input_shares,
+                                   param)
+        assert accepted == (level < start), level
+    # The level-0 edge case on the batched path too: accepted at 0,
+    # rejected at 1.
+    if malformed_level == 0:
+        assert _batched_accepts(mastic, nonce, bad, input_shares,
+                                _full_level_param(mastic, 0))
+    assert not _batched_accepts(mastic, nonce, bad, input_shares,
+                                _full_level_param(mastic, start))
+
+
+def test_malformed_flp_proof():
+    """A tweaked leader FLP proof share fails the weight check — and
+    only the weight check (non-weight-check rounds don't read it)."""
+    mastic = MasticCount(BITS)
+    (nonce, public_share, input_shares) = _make_report(mastic)
+    (key, proofs, seed, part) = input_shares[0]
+    bad_proofs = [proofs[0] + mastic.field(1)] + list(proofs[1:])
+    bad_shares = [(key, bad_proofs, seed, part), input_shares[1]]
+
+    wc_param = _full_level_param(mastic, 0, weight_check=True)
+    assert not _scalar_accepts(mastic, nonce, public_share, bad_shares,
+                               wc_param)
+    assert not _batched_accepts(mastic, nonce, public_share, bad_shares,
+                                wc_param)
+    # Unread on non-weight-check rounds.
+    param = _full_level_param(mastic, 0)
+    assert _scalar_accepts(mastic, nonce, public_share, bad_shares,
+                           param)
+    assert _batched_accepts(mastic, nonce, public_share, bad_shares,
+                            param)
+
+
+def test_malformed_weight_rejected_by_flp():
+    """A counter/weight inconsistent with the circuit (weight > 1 for
+    Count) is rejected by the FLP on the weight-check round.  Built by
+    tampering beta via the level-0 payload correction word on *both*
+    counter and weight so the VIDPF checks still pass at level 0."""
+    mastic = MasticCount(BITS)
+    (nonce, public_share, input_shares) = _make_report(mastic)
+    cws = list(public_share)
+    (seed, ctrl, w, proof) = cws[0]
+    # beta becomes [1, 2]: counter still valid, weight fails x^2-x=0.
+    cws[0] = (seed, ctrl, [w[0], w[1] + mastic.field(1)], proof)
+    wc_param = _full_level_param(mastic, 0, weight_check=True)
+    assert not _scalar_accepts(mastic, nonce, cws, input_shares,
+                               wc_param)
+    assert not _batched_accepts(mastic, nonce, cws, input_shares,
+                                wc_param)
+
+
+def test_malformed_joint_rand_part():
+    """A tweaked peer joint-rand part breaks the joint-rand
+    confirmation (prep_next seed equality) for a joint-rand circuit."""
+    mastic = MasticHistogram(2, 4, 2)
+    rng = np.random.default_rng(3)
+    nonce = rng.integers(0, 256, 16, dtype=np.uint8).tobytes()
+    rand = rng.integers(0, 256, mastic.RAND_SIZE,
+                        dtype=np.uint8).tobytes()
+    (public_share, input_shares) = mastic.shard(
+        CTX, ((True, False), 2), nonce, rand)
+    (key, proofs, seed, part) = input_shares[0]
+    bad_part = bytes([part[0] ^ 1]) + part[1:]
+    bad_shares = [(key, proofs, seed, bad_part), input_shares[1]]
+    wc_param = (0, ((False,), (True,)), True)
+    assert _scalar_accepts(mastic, nonce, public_share, input_shares,
+                           wc_param)
+    assert not _scalar_accepts(mastic, nonce, public_share, bad_shares,
+                               wc_param)
+    assert not _batched_accepts(mastic, nonce, public_share, bad_shares,
+                                wc_param)

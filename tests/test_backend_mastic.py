@@ -12,7 +12,6 @@ import pytest
 from mastic_tpu import (MasticCount, MasticHistogram,
                         MasticMultihotCountVec, MasticSum, MasticSumVec)
 from mastic_tpu.backend.mastic_jax import BatchedMastic
-from mastic_tpu.common import vec_add
 
 CTX = b"batched mastic test"
 VERIFY_KEY = bytes(range(32))
@@ -102,6 +101,13 @@ def _run_round(mastic, measurements, agg_param, seed=0):
                 p.joint_rand_part[r]).tobytes() == jr_part_ref
             assert np.asarray(
                 preps[1].joint_rand_part[r]).tobytes() == shares[1][2]
+        if do_weight_check:
+            for agg_id in range(2):
+                got_v = np.asarray(preps[agg_id].verifier[r])
+                assert [bm.spec.limbs_to_int(got_v[i])
+                        for i in range(got_v.shape[0])] == \
+                    [x.int() for x in shares[agg_id][1]], \
+                    f"verifier share {agg_id} {r}"
         prep_msg = mastic.prep_shares_to_prep(CTX, agg_param, shares)
         for agg_id in range(2):
             out_ref = mastic.prep_next(CTX, states[agg_id], prep_msg)
@@ -110,16 +116,11 @@ def _run_round(mastic, measurements, agg_param, seed=0):
                     for i in range(got.shape[0])] == \
                 [x.int() for x in out_ref], f"out share {agg_id} {r}"
 
-    # Batched verifier shares + accept + aggregate + unshard.
-    if do_weight_check:
-        verifiers = [bm.flp_query_host(p) for p in preps]
-        # Cross-check one verifier pair against the scalar decide.
-        assert mastic.flp.decide(vec_add(verifiers[0][0],
-                                         verifiers[1][0]))
-    else:
-        verifiers = [None, None]
-    accept = bm.accept_mask(preps[0], preps[1], do_weight_check,
-                            verifiers[0], verifiers[1])
+    # Device accept (eval-proof equality + FLP decide + joint-rand
+    # confirmation) + aggregate + unshard.
+    accept = np.asarray(
+        jax.jit(lambda a, b: bm.accept_mask(a, b, do_weight_check))(
+            preps[0], preps[1]))
     assert accept.all()
     agg_shares = [
         bm.agg_share_to_host(

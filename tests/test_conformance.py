@@ -10,6 +10,7 @@ import os
 
 import pytest
 
+from mastic_tpu import testvec_codec as codec
 from mastic_tpu.mastic import (Mastic, MasticCount, MasticHistogram,
                                MasticMultihotCountVec, MasticSum,
                                MasticSumVec)
@@ -77,10 +78,10 @@ def test_vector(filename: str) -> None:
         # Client.
         (public_share, input_shares) = \
             mastic.shard(ctx, measurement, nonce, rand)
-        assert mastic.test_vec_encode_public_share(public_share).hex() == \
+        assert codec.encode_public_share(mastic, public_share).hex() == \
             prep["public_share"]
         for (agg_id, input_share) in enumerate(input_shares):
-            assert mastic.test_vec_encode_input_share(input_share).hex() \
+            assert codec.encode_input_share(mastic, input_share).hex() \
                 == prep["input_shares"][agg_id], f"input share {agg_id}"
 
         # Aggregators: prep.
@@ -90,13 +91,13 @@ def test_vector(filename: str) -> None:
             (state, share) = mastic.prep_init(
                 verify_key, ctx, agg_id, agg_param, nonce, public_share,
                 input_shares[agg_id])
-            assert mastic.test_vec_encode_prep_share(share).hex() == \
+            assert codec.encode_prep_share(mastic, share).hex() == \
                 prep["prep_shares"][0][agg_id], f"prep share {agg_id}"
             prep_states.append(state)
             prep_shares.append(share)
 
         prep_msg = mastic.prep_shares_to_prep(ctx, agg_param, prep_shares)
-        assert mastic.test_vec_encode_prep_msg(prep_msg).hex() == \
+        assert codec.encode_prep_msg(mastic, prep_msg).hex() == \
             prep["prep_messages"][0]
 
         for agg_id in range(2):
@@ -109,7 +110,7 @@ def test_vector(filename: str) -> None:
                 agg_param, agg_shares[agg_id], out_share)
 
     for agg_id in range(2):
-        assert mastic.test_vec_encode_agg_share(agg_shares[agg_id]).hex() \
+        assert codec.encode_agg_share(mastic, agg_shares[agg_id]).hex() \
             == test_vec["agg_shares"][agg_id], f"agg share {agg_id}"
 
     agg_result = mastic.unshard(agg_param, agg_shares,

@@ -1,0 +1,169 @@
+"""Differential tests: batched FLP (flp/flp_jax.py) vs the scalar
+byte-exact layer (flp/flp.py), for all five circuits.
+
+The scalar layer is conformance-locked against the reference vectors,
+so element-exact agreement here extends that lock to the device path.
+"""
+
+import random
+
+import jax
+import numpy as np
+import pytest
+
+from mastic_tpu.field import Field64, Field128
+from mastic_tpu.flp.circuits import (Count, Histogram, MultihotCountVec,
+                                     Sum, SumVec)
+from mastic_tpu.flp.flp import FlpBBCGGI19
+from mastic_tpu.flp.flp_jax import BatchedFlp
+from mastic_tpu.ops.field_jax import spec_for
+
+CIRCUITS = [
+    ("count", lambda: Count(Field64), 1),
+    ("sum", lambda: Sum(Field64, 7), 5),
+    ("sumvec", lambda: SumVec(Field128, 3, 1, 1), [1, 0, 1]),
+    ("histogram", lambda: Histogram(Field128, 4, 2), 2),
+    ("multihot", lambda: MultihotCountVec(Field128, 4, 2, 2),
+     [True, False, True, False]),
+]
+
+
+def _rand_vec(field, length, rng):
+    return [field(rng.randrange(field.MODULUS)) for _ in range(length)]
+
+
+def _to_limbs(spec, vec):
+    return np.stack([spec.int_to_limbs(x.int()) for x in vec]) \
+        if vec else np.zeros((0, spec.num_limbs), np.uint32)
+
+
+def _from_limbs(spec, field, arr):
+    return [field(spec.limbs_to_int(arr[i])) for i in range(arr.shape[0])]
+
+
+@pytest.mark.parametrize("name,make,meas", CIRCUITS,
+                         ids=[c[0] for c in CIRCUITS])
+def test_query_matches_scalar(name, make, meas):
+    rng = random.Random(f"query {name}")
+    flp = FlpBBCGGI19(make())
+    bf = BatchedFlp(flp)
+    spec = spec_for(flp.field)
+    field = flp.field
+
+    batch_meas = []
+    batch_proof = []
+    batch_qr = []
+    batch_jr = []
+    expected = []
+    for _ in range(5):
+        # Random (not necessarily valid) algebra inputs: query is
+        # deterministic in them, so exact agreement is well-defined.
+        meas_share = _rand_vec(field, flp.MEAS_LEN, rng)
+        proof_share = _rand_vec(field, flp.PROOF_LEN, rng)
+        query_rand = _rand_vec(field, flp.QUERY_RAND_LEN, rng)
+        joint_rand = _rand_vec(field, flp.JOINT_RAND_LEN, rng)
+        expected.append(flp.query(meas_share, proof_share, query_rand,
+                                  joint_rand, 2))
+        batch_meas.append(_to_limbs(spec, meas_share))
+        batch_proof.append(_to_limbs(spec, proof_share))
+        batch_qr.append(_to_limbs(spec, query_rand))
+        batch_jr.append(_to_limbs(spec, joint_rand))
+
+    if flp.JOINT_RAND_LEN:
+        fn = jax.jit(lambda m, p, q, j: bf.query(m, p, q, j, 2))
+        (verifier, ok) = fn(np.stack(batch_meas), np.stack(batch_proof),
+                            np.stack(batch_qr), np.stack(batch_jr))
+    else:
+        fn = jax.jit(lambda m, p, q: bf.query(m, p, q, None, 2))
+        (verifier, ok) = fn(np.stack(batch_meas), np.stack(batch_proof),
+                            np.stack(batch_qr))
+    verifier = np.asarray(verifier)
+    assert bool(np.all(np.asarray(ok)))
+    for (r, exp) in enumerate(expected):
+        got = _from_limbs(spec, field, verifier[r])
+        assert got == exp, f"report {r}"
+
+
+@pytest.mark.parametrize("name,make,meas", CIRCUITS,
+                         ids=[c[0] for c in CIRCUITS])
+def test_prove_matches_scalar(name, make, meas):
+    rng = random.Random(f"prove {name}")
+    flp = FlpBBCGGI19(make())
+    bf = BatchedFlp(flp)
+    spec = spec_for(flp.field)
+    field = flp.field
+
+    encoded = flp.encode(meas)
+    batch_meas = []
+    batch_pr = []
+    batch_jr = []
+    expected = []
+    for _ in range(4):
+        prove_rand = _rand_vec(field, flp.PROVE_RAND_LEN, rng)
+        joint_rand = _rand_vec(field, flp.JOINT_RAND_LEN, rng)
+        expected.append(flp.prove(encoded, prove_rand, joint_rand))
+        batch_meas.append(_to_limbs(spec, encoded))
+        batch_pr.append(_to_limbs(spec, prove_rand))
+        batch_jr.append(_to_limbs(spec, joint_rand))
+
+    if flp.JOINT_RAND_LEN:
+        fn = jax.jit(bf.prove)
+        proof = np.asarray(fn(np.stack(batch_meas), np.stack(batch_pr),
+                              np.stack(batch_jr)))
+    else:
+        fn = jax.jit(lambda m, p: bf.prove(m, p, None))
+        proof = np.asarray(fn(np.stack(batch_meas), np.stack(batch_pr)))
+    for (r, exp) in enumerate(expected):
+        got = _from_limbs(spec, field, proof[r])
+        assert got == exp, f"report {r}"
+
+
+@pytest.mark.parametrize("name,make,meas", CIRCUITS,
+                         ids=[c[0] for c in CIRCUITS])
+def test_roundtrip_decide(name, make, meas):
+    """Honest prove -> split shares -> query x2 -> sum -> decide=True;
+    a tampered measurement share flips decide to False."""
+    rng = random.Random(f"decide {name}")
+    flp = FlpBBCGGI19(make())
+    bf = BatchedFlp(flp)
+    spec = spec_for(flp.field)
+    field = flp.field
+
+    encoded = flp.encode(meas)
+    prove_rand = _rand_vec(field, flp.PROVE_RAND_LEN, rng)
+    joint_rand = _rand_vec(field, flp.JOINT_RAND_LEN, rng)
+    proof = flp.prove(encoded, prove_rand, joint_rand)
+    query_rand = _rand_vec(field, flp.QUERY_RAND_LEN, rng)
+
+    meas0 = _rand_vec(field, flp.MEAS_LEN, rng)
+    meas1 = [a - b for (a, b) in zip(encoded, meas0)]
+    proof0 = _rand_vec(field, flp.PROOF_LEN, rng)
+    proof1 = [a - b for (a, b) in zip(proof, proof0)]
+
+    if flp.JOINT_RAND_LEN:
+        qfn = jax.jit(lambda m, p, q, j: bf.query(m, p, q, j, 2))
+    else:
+        qfn = jax.jit(lambda m, p, q, j: bf.query(m, p, q, None, 2))
+    verifiers = []
+    for (mshare, pshare) in ((meas0, proof0), (meas1, proof1)):
+        (verifier, ok) = qfn(
+            _to_limbs(spec, mshare)[None], _to_limbs(spec, pshare)[None],
+            _to_limbs(spec, query_rand)[None],
+            _to_limbs(spec, joint_rand)[None])
+        assert bool(np.asarray(ok)[0])
+        verifiers.append(_from_limbs(spec, field,
+                                     np.asarray(verifier)[0]))
+
+    summed = [a + b for (a, b) in zip(*verifiers)]
+    assert flp.decide(summed)
+    dfn = jax.jit(bf.decide)
+    got = dfn(_to_limbs(spec, summed)[None])
+    assert bool(np.asarray(got)[0])
+
+    # Tamper: shift one element of one verifier share (models a bad
+    # measurement); both scalar and batched must reject.
+    bad = list(summed)
+    bad[0] += field(1)
+    assert not flp.decide(bad)
+    got_bad = dfn(_to_limbs(spec, bad)[None])
+    assert not bool(np.asarray(got_bad)[0])

@@ -1,0 +1,126 @@
+"""Incremental cross-round evaluation: byte-equality with the
+from-root batched path, and the runtime-length sponge vs the static
+one."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from mastic_tpu import MasticCount, MasticSum
+from mastic_tpu.backend.incremental import (IncrementalMastic, RoundPlan,
+                                            round_inputs)
+from mastic_tpu.backend.mastic_jax import BatchedMastic
+from mastic_tpu.drivers.heavy_hitters import (compute_heavy_hitters,
+                                              get_reports_from_measurements)
+from mastic_tpu.oracle import weighted_heavy_hitters
+from mastic_tpu.ops.keccak_jax import (turbo_shake128,
+                                       turbo_shake128_dynamic)
+
+CTX = b"incremental test"
+VK = bytes(range(32))
+
+
+def test_dynamic_sponge_matches_static():
+    rng = np.random.default_rng(0)
+    msg = rng.integers(0, 256, (3, 400), dtype=np.uint8)
+    fn = jax.jit(lambda m, ln: turbo_shake128_dynamic(m, ln, 1, 32))
+    for length in [0, 1, 17, 167, 168, 169, 200, 335, 336, 399, 400]:
+        want = np.asarray(turbo_shake128(
+            jnp.asarray(msg[:, :length]), 1, 32))
+        got = np.asarray(fn(jnp.asarray(msg), jnp.int32(length)))
+        np.testing.assert_array_equal(got, want, err_msg=str(length))
+
+
+def _reports(mastic, values, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for (v, w) in values:
+        alpha = mastic.vidpf.test_index_from_int(v, mastic.vidpf.BITS)
+        nonce = rng.integers(0, 256, 16, dtype=np.uint8).tobytes()
+        rand = rng.integers(0, 256, mastic.RAND_SIZE,
+                            dtype=np.uint8).tobytes()
+        out.append((nonce,) + mastic.shard(CTX, (alpha, w), nonce, rand))
+    return out
+
+
+def test_incremental_eval_proof_matches_from_root():
+    """Per level, the engine's eval proofs must equal the from-root
+    batched prep's (wire-exact binder assembly across the carry)."""
+    mastic = MasticCount(4)
+    bm = BatchedMastic(mastic)
+    reports = _reports(mastic, [(0b1010, 1), (0b1011, 1), (0b0001, 1)])
+    batch = bm.marshal_reports(reports)
+    num = len(reports)
+
+    engine = IncrementalMastic(bm, width=8)
+    (ext_rk, conv_rk) = bm.vidpf.roundkeys(CTX, batch.nonces)
+    carries = [engine.init_carry(num, batch.keys[:, a], a)
+               for a in range(2)]
+    carried_paths: list = []
+    prev_paths = None
+
+    # A pruned frontier path: keep only prefixes under 10*.
+    frontiers = [
+        [(False,), (True,)],
+        [(True, False), (True, True)],
+        [(True, False, True), (True, False, False)],
+        [(True, False, True, False), (True, False, True, True)],
+    ]
+    for (level, prefixes) in enumerate(frontiers):
+        plan = RoundPlan(tuple(prefixes), level, 4, 8, prev_paths,
+                         carried_paths)
+        rnd = round_inputs(plan)
+        proofs = []
+        outs = []
+        for a in range(2):
+            (carries[a], proof, out, ok) = jax.jit(
+                lambda c, r, agg=a: engine.agg_round(
+                    agg, VK, CTX, c, r, ext_rk, conv_rk, batch.cws))(
+                carries[a], rnd)
+            assert bool(np.all(np.asarray(ok)))
+            proofs.append(np.asarray(proof))
+            outs.append(np.asarray(out))
+        carried_paths = plan.needed
+        prev_paths = plan.needed[level]
+
+        # From-root reference for the same agg param.
+        agg_param = (level, tuple(prefixes), False)
+        (p0, p1) = bm.prep_both(VK, CTX, agg_param, batch)
+        np.testing.assert_array_equal(proofs[0],
+                                      np.asarray(p0.eval_proof),
+                                      err_msg=f"level {level} agg 0")
+        np.testing.assert_array_equal(proofs[1],
+                                      np.asarray(p1.eval_proof),
+                                      err_msg=f"level {level} agg 1")
+        rows = len(prefixes) * (1 + mastic.flp.OUTPUT_LEN)
+        np.testing.assert_array_equal(
+            outs[0][:, :rows], np.asarray(p0.out_share),
+            err_msg=f"level {level} out 0")
+        np.testing.assert_array_equal(
+            outs[1][:, :rows], np.asarray(p1.out_share),
+            err_msg=f"level {level} out 1")
+
+
+@pytest.mark.parametrize("make,values,threshold", [
+    (lambda: MasticCount(5),
+     [(0b10101, 1)] * 3 + [(0b10110, 1)] * 2 + [(0b00101, 1)], 3),
+    (lambda: MasticSum(4, 7),
+     [(0b1010, 3), (0b1010, 4), (0b0110, 7), (0b0001, 1)], 7),
+])
+def test_heavy_hitters_incremental_matches_from_root(make, values,
+                                                     threshold):
+    mastic = make()
+    reports = get_reports_from_measurements(
+        mastic, CTX,
+        [(mastic.vidpf.test_index_from_int(v, mastic.vidpf.BITS), w)
+         for (v, w) in values])
+    thresholds = {"default": threshold}
+    got_inc = compute_heavy_hitters(mastic, CTX, thresholds, reports,
+                                    verify_key=VK, incremental=True)
+    got_root = compute_heavy_hitters(mastic, CTX, thresholds, reports,
+                                     verify_key=VK, incremental=False)
+    oracle = weighted_heavy_hitters(
+        [(mastic.vidpf.test_index_from_int(v, mastic.vidpf.BITS), w)
+         for (v, w) in values], threshold, mastic.vidpf.BITS)
+    assert got_inc == got_root == oracle

@@ -55,15 +55,18 @@ def test_sharded_round_matches_unsharded():
                    jnp.asarray(keys[1]))
 
     # Sharded across a (4 reports x 2 nodes) mesh.
+    from mastic_tpu.backend.mastic_jax import ReportBatch
     mesh = make_mesh(8, nodes_axis=2)
+    batch = ReportBatch(
+        nonces=shard_batch(mesh, jnp.asarray(nonces)),
+        cws=jax.tree.map(lambda x: shard_batch(mesh, x), cws),
+        keys=shard_batch(mesh, jnp.asarray(np.stack(keys, axis=1))),
+        leader_proofs=None, helper_seeds=None, leader_seeds=None,
+        peer_parts=(None, None))
     install_grid_sharding(bm, mesh)
     try:
         fn = sharded_round_fn(bm, mesh, VK, CTX, agg_param)
-        sharded = fn(
-            shard_batch(mesh, jnp.asarray(nonces)),
-            jax.tree.map(lambda x: shard_batch(mesh, x), cws),
-            shard_batch(mesh, jnp.asarray(keys[0])),
-            shard_batch(mesh, jnp.asarray(keys[1])))
+        sharded = fn(batch)
     finally:
         bm.vidpf.constrain_state = None
 
@@ -79,6 +82,34 @@ def test_sharded_round_matches_unsharded():
         len(reports))
     assert result == [sum(1 for v in values if v >> 1 == p)
                       for p in range(4)]
+
+
+def test_sharded_weight_check_round():
+    """The fused sharded round must also cover weight-check rounds
+    (device FLP query + decide under pjit)."""
+    mastic = MasticCount(3)
+    bm = BatchedMastic(mastic)
+    values = [0b101, 0b100, 0b101, 0b001, 0b101, 0b100, 0b110, 0b000]
+    reports = _reports(mastic, values, seed=7)
+    batch = bm.marshal_reports(reports)
+    agg_param = (0, ((False,), (True,)), True)
+
+    mesh = make_mesh(8, nodes_axis=2)
+    batch = jax.tree.map(lambda x: shard_batch(mesh, x), batch)
+    install_grid_sharding(bm, mesh)
+    try:
+        fn = sharded_round_fn(bm, mesh, VK, CTX, agg_param)
+        (agg0, agg1, accept, ok) = fn(batch)
+    finally:
+        bm.vidpf.constrain_state = None
+    assert bool(np.all(np.asarray(accept)))
+    assert bool(np.all(np.asarray(ok)))
+    result = mastic.unshard(
+        agg_param,
+        [bm.agg_share_to_host(agg0), bm.agg_share_to_host(agg1)],
+        len(reports))
+    assert result == [sum(1 for v in values if v >> 2 == p)
+                      for p in range(2)]
 
 
 def _round(bm, agg_param, nonces, cws, k0, k1):
