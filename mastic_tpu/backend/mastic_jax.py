@@ -375,11 +375,17 @@ class BatchedMastic:
         """One full simulated aggregation round on device: both preps,
         all checks (incl. the FLP verifier exchange), masked
         aggregation.  Returns (agg_share0, agg_share1, accept, ok) —
-        jittable; weight-check rounds included."""
+        jittable; weight-check rounds included.
+
+        Lanes where XOF rejection sampling fired (ok=False) hold
+        garbage and are excluded from the aggregates; the driver
+        recomputes those reports through the scalar path and splices
+        their contributions in (drivers/heavy_hitters.py).
+        """
         (_level, _prefixes, do_weight_check) = agg_param
         (p0, p1) = self.prep_both(verify_key, ctx, agg_param, batch)
         accept = self.accept_mask(p0, p1, do_weight_check)
         ok = p0.ok & p1.ok
-        agg0 = self.aggregate(p0.out_share, accept)
-        agg1 = self.aggregate(p1.out_share, accept)
+        agg0 = self.aggregate(p0.out_share, accept & ok)
+        agg1 = self.aggregate(p1.out_share, accept & ok)
         return (agg0, agg1, accept, ok)
