@@ -59,6 +59,34 @@ class Carry(NamedTuple):
     ctrl: jax.Array
 
 
+def _ancestors(prefixes: Sequence[Path],
+               level: int) -> list[list[Path]]:
+    """Per depth d <= level, the distinct ancestors of `prefixes` at
+    depth d, in lexicographic order."""
+    return [
+        sorted(set(p[:d + 1] for p in prefixes))
+        for d in range(level + 1)
+    ]
+
+
+def needed_paths(prefixes: Sequence[Path], level: int,
+                 anc: Optional[list[list[Path]]] = None,
+                 ) -> list[list[Path]]:
+    """Per depth d <= level, the nodes a round at `level` touches:
+    both children of every ancestor of `prefixes` at depth d-1, in
+    lexicographic order — exactly the reference's BFS materialization
+    order (mastic.py:258-287).  Depends only on (prefixes, level), so
+    a restored checkpoint can rebuild the carried-path maps from the
+    last aggregation parameter alone."""
+    if anc is None:
+        anc = _ancestors(prefixes, level)
+    return [
+        [par + (b,) for par in (anc[d - 1] if d else [()])
+         for b in (False, True)]
+        for d in range(level + 1)
+    ]
+
+
 class RoundPlan:
     """Host-side runtime inputs for one incremental round.
 
@@ -81,17 +109,10 @@ class RoundPlan:
         self.width = width
         self.prefixes = tuple(prefixes)
 
-        anc: list[list[Path]] = [
-            sorted(set(p[:d + 1] for p in prefixes))
-            for d in range(level + 1)
-        ]
+        anc = _ancestors(prefixes, level)
         if any(len(a) > half for a in anc):
             raise ValueError("frontier exceeds padded width")
-        needed: list[list[Path]] = [
-            [par + (b,) for par in (anc[d - 1] if d else [()])
-             for b in (False, True)]
-            for d in range(level + 1)
-        ]
+        needed = needed_paths(prefixes, level, anc)
         self.needed = needed
 
         # Prune gather: position of needed[d] inside the previously
@@ -377,6 +398,30 @@ class IncrementalMastic:
             dst_alg(ctx, USAGE_EVAL_PROOF, bm.m.ID), verify_key,
             (onehot_check, counter_check, payload_check), PROOF_SIZE,
             (num_reports,))
+
+
+# -- checkpoint / resume (SURVEY.md §5; the reference's cache-across-
+# -- rounds note, /root/reference/poc/vidpf.py:243-245) -------------
+
+def carry_to_arrays(carry: Carry, prefix: str = "") -> dict:
+    """A Carry as named host arrays (for np.savez-style persistence)."""
+    return {
+        prefix + "w": np.asarray(carry.w),
+        prefix + "proof": np.asarray(carry.proof),
+        prefix + "seed": np.asarray(carry.seed),
+        prefix + "ctrl": np.asarray(carry.ctrl),
+    }
+
+
+def carry_from_arrays(arrays, prefix: str = "") -> Carry:
+    """Inverse of carry_to_arrays (accepts any mapping of arrays)."""
+    return Carry(
+        w=jnp.asarray(np.asarray(arrays[prefix + "w"], np.uint32)),
+        proof=jnp.asarray(np.asarray(arrays[prefix + "proof"],
+                                     np.uint8)),
+        seed=jnp.asarray(np.asarray(arrays[prefix + "seed"], np.uint8)),
+        ctrl=jnp.asarray(np.asarray(arrays[prefix + "ctrl"], bool)),
+    )
 
 
 def _prefix_len(ctx: bytes, usage: int, alg_id: int) -> int:

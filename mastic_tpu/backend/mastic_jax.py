@@ -380,7 +380,8 @@ class BatchedMastic:
         Lanes where XOF rejection sampling fired (ok=False) hold
         garbage and are excluded from the aggregates; the driver
         recomputes those reports through the scalar path and splices
-        their contributions in (drivers/heavy_hitters.py).
+        their contributions in (drivers/heavy_hitters.py:
+        splice_rejected).
         """
         (_level, _prefixes, do_weight_check) = agg_param
         (p0, p1) = self.prep_both(verify_key, ctx, agg_param, batch)

@@ -3,13 +3,14 @@ SURVEY.md §1): weighted heavy hitters, attribute-based metrics, and
 the communication-cost report, all running on the batched TPU backend
 with the host orchestrating the multi-round collector loop."""
 
-from .heavy_hitters import (compute_heavy_hitters, get_threshold,
+from .heavy_hitters import (HeavyHittersRun, compute_heavy_hitters,
+                            get_threshold,
                             get_reports_from_measurements, run_round)
 from .attribute_metrics import aggregate_by_attribute, hash_attribute
 from .communication import communication_report
 
 __all__ = [
-    "compute_heavy_hitters", "get_threshold",
+    "HeavyHittersRun", "compute_heavy_hitters", "get_threshold",
     "get_reports_from_measurements", "run_round",
     "aggregate_by_attribute", "hash_attribute",
     "communication_report",

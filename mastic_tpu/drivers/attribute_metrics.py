@@ -43,5 +43,5 @@ def aggregate_by_attribute(mastic: Mastic, ctx: bytes,
         raise ValueError("attribute hash collision; increase BITS")
     agg_param = (level, prefixes, True)
     assert mastic.is_valid(agg_param, [])
-    result = run_round(bm, verify_key, ctx, agg_param, batch)
+    result = run_round(bm, verify_key, ctx, agg_param, batch, reports)
     return list(zip(attributes, result))

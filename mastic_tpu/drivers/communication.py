@@ -6,14 +6,23 @@ Reports this framework's *measured* wire sizes by encoding real
 reports for the same configs the reference benchmarks, plus the
 protocol-shape facts the spec itself states (1 prep round vs
 Poplar1's 2; O(num_measurements x BITS) inter-aggregator traffic,
-draft-mouris-cfrg-mastic.md:166-168, :1619-1623).  The Poplar1 /
-Prio3 implementations themselves are out of the framework's scope
-(SURVEY.md §2.2), and their byte counts are not archived in
-BASELINE.md, so no numbers are invented for them here.
+draft-mouris-cfrg-mastic.md:166-168, :1619-1623).
+
+The reference's headline comparison — Mastic vs Poplar1(256) upload,
+MasticHistogram vs Prio3Histogram upload — is reproduced *analytically*
+from the published vdaf-13 constants (the Poplar1/Prio3
+implementations themselves are out of scope, SURVEY.md §2.2):
+Poplar1's sizes follow from the IdpfBBCGGI21 wire structure (vdaf-13
+§8), and Prio3Histogram's from the Prio3 wire layout (vdaf-13 §7) with
+MEAS_LEN/PROOF_LEN taken from this framework's own vector-locked
+Histogram circuit — Prio3 uses the identical BBCGGI19 circuit family.
 """
 
 from .. import testvec_codec as codec
 from ..common import gen_rand
+from ..field import Field128
+from ..flp.circuits import Histogram
+from ..flp.flp import FlpBBCGGI19
 from ..mastic import Mastic, MasticCount, MasticHistogram, MasticSum
 
 
@@ -35,8 +44,63 @@ def report_sizes(mastic: Mastic, measurement) -> dict:
     }
 
 
+def poplar1_sizes(bits: int) -> dict:
+    """Analytic Poplar1(bits) upload sizes from the published vdaf-13
+    §8 wire structure (the comparison target of the reference's
+    example_poplar1_overhead, /root/reference/poc/examples.py:263-281).
+
+    IdpfBBCGGI21: KEY_SIZE 16, two packed control bits per level, a
+    16-byte seed correction per level, VALUE_LEN 2 payload corrections
+    over Field64 (8 B) for inner levels and Field255 (32 B) for the
+    leaf.  Each input share carries the IDPF key and a 32-byte
+    correlated-randomness seed; the leader additionally carries the
+    explicit sketch correlation — a (a, b, c) triple per level, Field64
+    inner / Field255 leaf.
+    """
+    public = ((2 * bits + 7) // 8    # packed control bits
+              + bits * 16            # seed corrections
+              + (bits - 1) * 2 * 8   # inner payload corrections
+              + 2 * 32)              # leaf payload correction
+    leader = 16 + 32 + 3 * (bits - 1) * 8 + 3 * 32
+    helper = 16 + 32
+    return {
+        "public_share": public,
+        "leader_share": leader,
+        "helper_share": helper,
+        "upload": public + leader + helper,
+        "analytic": True,
+    }
+
+
+def prio3_histogram_sizes(length: int, chunk_length: int) -> dict:
+    """Analytic Prio3Histogram(2 shares, length, chunk_length) upload
+    sizes from the vdaf-13 §7 wire layout, with MEAS_LEN / PROOF_LEN
+    taken from this framework's vector-locked Histogram circuit (Prio3
+    instantiates the identical BBCGGI19 circuit over Field128).
+
+    Public share: one 32-byte joint-rand part per aggregator.  Leader
+    share: explicit measurement + proof shares plus a 32-byte
+    joint-rand blind.  Helper share: a 32-byte expansion seed plus the
+    blind.
+    """
+    flp = FlpBBCGGI19(Histogram(Field128, length, chunk_length))
+    elem = Field128.ENCODED_SIZE
+    public = 2 * 32
+    leader = (flp.MEAS_LEN + flp.PROOF_LEN) * elem + 32
+    helper = 32 + 32
+    return {
+        "public_share": public,
+        "leader_share": leader,
+        "helper_share": helper,
+        "upload": public + leader + helper,
+        "analytic": True,
+    }
+
+
 def communication_report(print_fn=print) -> dict:
-    """Mastic upload sizes for the reference's comparison configs."""
+    """Mastic upload sizes for the reference's comparison configs,
+    plus the analytic Poplar1/Prio3 comparison story
+    (reference examples.py:263-364)."""
     out = {}
     alpha256 = (False,) * 256
 
@@ -46,7 +110,19 @@ def communication_report(print_fn=print) -> dict:
         MasticSum(256, 255), (alpha256, 17))
     out["MasticHistogram(32, 100, 10)"] = report_sizes(
         MasticHistogram(32, 100, 10), ((False,) * 32, 3))
+    out["Poplar1(256)"] = poplar1_sizes(256)
+    # The reference compares MasticHistogram(32, 100, 10) in
+    # attribute-metrics mode (100 attributes x 100 buckets) against a
+    # flat Prio3Histogram over 100*100 buckets with
+    # chunk = floor(sqrt(10000)) (examples.py:343-346).
+    out["Prio3Histogram(10000, 100)"] = prio3_histogram_sizes(10000, 100)
     out["prep_rounds"] = {"mastic": 1, "poplar1_spec": 2}
+    out["mastic_count_vs_poplar1_upload"] = (
+        out["MasticCount(256)"]["upload"]
+        / out["Poplar1(256)"]["upload"])
+    out["prio3_vs_mastic_histogram_upload"] = (
+        out["Prio3Histogram(10000, 100)"]["upload"]
+        / out["MasticHistogram(32, 100, 10)"]["upload"])
 
     for (name, sizes) in out.items():
         print_fn(f"{name}: {sizes}")

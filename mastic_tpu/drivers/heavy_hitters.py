@@ -21,7 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..common import gen_rand, vec_add
-from ..mastic import Mastic
+from ..mastic import Mastic, ReportRejected
 from ..backend.mastic_jax import BatchedMastic, ReportBatch
 
 
@@ -66,30 +66,78 @@ def _round_fn(bm: BatchedMastic, verify_key: bytes, ctx: bytes,
 
 def run_round(bm: BatchedMastic, verify_key: bytes, ctx: bytes,
               agg_param, batch: ReportBatch,
+              reports: Optional[list] = None,
               accept_out: Optional[list] = None) -> list:
     """One aggregation round on the batched backend: both preps, all
     checks (incl. the device FLP on weight-check rounds), masked
     aggregation, unshard.  Returns the per-prefix aggregate result;
-    appends the accept mask to `accept_out`."""
+    appends the accept mask to `accept_out`.
+
+    `reports` is the host-side report list backing `batch`; it is only
+    touched when XOF rejection sampling fires for some lane (the scalar
+    fallback, see `splice_rejected`)."""
     (agg0, agg1, accept, ok) = _round_fn(bm, verify_key, ctx,
                                          agg_param)(batch)
-    _require_ok(ok)
-    accept = np.asarray(accept)
+    accept = np.asarray(accept).copy()
+    agg_shares = [bm.agg_share_to_host(a) for a in (agg0, agg1)]
+    splice_rejected(bm.m, verify_key, ctx, agg_param, reports,
+                    np.asarray(ok), accept, agg_shares)
     if accept_out is not None:
         accept_out.append(accept)
-    agg_shares = [bm.agg_share_to_host(a) for a in (agg0, agg1)]
     num = int(accept.sum())
     return bm.m.unshard(agg_param, agg_shares, num)
 
 
-def _require_ok(ok) -> None:
-    """Rejection sampling fired (~2^-32/element): the scalar fallback
-    for affected reports is not wired up yet, so fail loudly rather
-    than silently diverge."""
-    if not bool(np.all(np.asarray(ok))):
-        raise NotImplementedError(
-            "XOF rejection-sampling fallback not yet implemented for "
-            "this batch")
+def scalar_round_out_shares(m: Mastic, verify_key: bytes, ctx: bytes,
+                            agg_param, report) -> Optional[list]:
+    """One report through the scalar protocol round (both preps, the
+    prep-share exchange, prep_next).  Returns the two out shares, or
+    None if the report is rejected by the checks.
+
+    The scalar layer's XOF sampler implements the true rejection loop
+    (vdaf-13 §6.2; reference consumption /root/reference/poc/
+    vidpf.py:352-364), so this path is exact for the lanes the batched
+    sampler flags."""
+    (nonce, public_share, input_shares) = report
+    states = []
+    shares = []
+    for agg_id in range(2):
+        (state, share) = m.prep_init(verify_key, ctx, agg_id, agg_param,
+                                     nonce, public_share,
+                                     input_shares[agg_id])
+        states.append(state)
+        shares.append(share)
+    try:
+        prep_msg = m.prep_shares_to_prep(ctx, agg_param, shares)
+        return [m.prep_next(ctx, state, prep_msg) for state in states]
+    except ReportRejected:
+        return None
+
+
+def splice_rejected(m: Mastic, verify_key: bytes, ctx: bytes, agg_param,
+                    reports: Optional[list], ok: np.ndarray,
+                    accept: np.ndarray, agg_shares: list) -> None:
+    """The XOF rejection-sampling fallback (vdaf-13 §6.2).
+
+    Lanes where `ok` is False sampled a field element outside the
+    field (~2^-32 per element for Field64): their device results are
+    garbage, and the device aggregates already exclude them.  Recompute
+    exactly those reports through the scalar layer and splice their
+    out shares and accept bits into the round's host-side results
+    (`accept` and `agg_shares` are mutated in place)."""
+    if ok.all():
+        return
+    if reports is None:
+        raise ValueError(
+            "XOF rejection sampling fired but the host reports needed "
+            "for the scalar fallback were not provided")
+    for r in np.flatnonzero(~ok):
+        out_shares = scalar_round_out_shares(m, verify_key, ctx,
+                                             agg_param, reports[r])
+        accept[r] = out_shares is not None
+        if out_shares is not None:
+            for a in range(2):
+                agg_shares[a] = vec_add(agg_shares[a], out_shares[a])
 
 
 def compute_heavy_hitters(mastic: Mastic, ctx: bytes, thresholds: dict,
@@ -106,38 +154,215 @@ def compute_heavy_hitters(mastic: Mastic, ctx: bytes, thresholds: dict,
     `incremental=False` path re-evaluates from the root each round
     (one compile per level) and serves as the differential reference.
     """
-    if verify_key is None:
-        verify_key = gen_rand(mastic.VERIFY_KEY_SIZE)
-    bm = BatchedMastic(mastic)
-    batch = bm.marshal_reports(reports)
-    runner = (_IncrementalRunner(bm, verify_key, ctx, batch)
-              if incremental else None)
+    run = HeavyHittersRun(mastic, ctx, thresholds, reports,
+                          verify_key=verify_key,
+                          incremental=incremental)
+    while run.step():
+        pass
+    return run.result()
 
-    prefixes: list = [(False,), (True,)]
-    prev_agg_params: list = []
-    heavy_hitters: list = []
-    for level in range(mastic.vidpf.BITS):
-        if not prefixes:
-            break
-        agg_param = (level, tuple(prefixes), level == 0)
-        assert mastic.is_valid(agg_param, prev_agg_params)
-        if runner is not None:
-            agg_result = runner.round(agg_param)
+
+_CKPT_VERSION = 1
+
+
+def _ckpt_binding(verify_key: bytes, ctx: bytes,
+                  thresholds: dict) -> np.ndarray:
+    """Digest binding a checkpoint to its (verify_key, ctx,
+    thresholds): restoring under a different key/context would
+    silently reject every report (the carries were derived under the
+    old key), and different thresholds would prune a different
+    frontier — make either mismatch loud instead."""
+    import hashlib
+    thresh_repr = repr(sorted(thresholds.items(), key=repr)).encode()
+    digest = hashlib.sha256(
+        len(verify_key).to_bytes(2, "little") + verify_key +
+        len(ctx).to_bytes(2, "little") + ctx + thresh_repr
+    ).digest()
+    return np.frombuffer(digest, np.uint8)
+
+
+def _paths_to_array(paths) -> np.ndarray:
+    if not paths:
+        return np.zeros((0, 0), bool)
+    return np.array([[bool(b) for b in p] for p in paths], bool)
+
+
+def _paths_from_array(arr) -> list:
+    return [tuple(bool(x) for x in row) for row in np.asarray(arr)]
+
+
+class HeavyHittersRun:
+    """A resumable heavy-hitters collection run: one `step()` per tree
+    level, checkpointable between levels (SURVEY.md §5; the state the
+    reference would persist is named at examples.py:48,75 plus the
+    cache-across-rounds tree, vidpf.py:243-245).
+
+    `to_bytes()` serializes the collector state and both aggregators'
+    incremental carries; `from_bytes()` restores a run that continues
+    bit-identically.  The report store itself is the caller's to
+    persist (a real deployment keeps uploads in a database); the
+    checkpoint covers everything derived from them.
+    """
+
+    def __init__(self, mastic: Mastic, ctx: bytes, thresholds: dict,
+                 reports: list, verify_key: Optional[bytes] = None,
+                 incremental: bool = True):
+        if verify_key is None:
+            verify_key = gen_rand(mastic.VERIFY_KEY_SIZE)
+        self.mastic = mastic
+        self.ctx = ctx
+        self.thresholds = thresholds
+        self.reports = reports
+        self.verify_key = verify_key
+        self.bm = BatchedMastic(mastic)
+        self.batch = self.bm.marshal_reports(reports)
+        self.runner = (
+            _IncrementalRunner(self.bm, verify_key, ctx, self.batch,
+                               reports)
+            if incremental else None)
+        self.level = 0
+        self.prefixes: list = [(False,), (True,)]
+        self.prev_agg_params: list = []
+        self.heavy_hitters: list = []
+        self.done = False
+
+    def step(self) -> bool:
+        """Run one level's aggregation round.  Returns True while more
+        rounds remain."""
+        if self.done:
+            return False
+        if not self.prefixes:
+            self.done = True
+            return False
+        level = self.level
+        agg_param = (level, tuple(self.prefixes), level == 0)
+        assert self.mastic.is_valid(agg_param, self.prev_agg_params)
+        if self.runner is not None:
+            agg_result = self.runner.round(agg_param)
         else:
-            agg_result = run_round(bm, verify_key, ctx, agg_param,
-                                   batch)
-        prev_agg_params.append(agg_param)
+            agg_result = run_round(self.bm, self.verify_key, self.ctx,
+                                   agg_param, self.batch, self.reports)
+        self.prev_agg_params.append(agg_param)
 
         survivors = [
-            prefix for (prefix, count) in zip(prefixes, agg_result)
-            if count >= get_threshold(thresholds, prefix)
+            prefix for (prefix, count) in zip(self.prefixes, agg_result)
+            if count >= get_threshold(self.thresholds, prefix)
         ]
-        if level < mastic.vidpf.BITS - 1:
-            prefixes = [p + (bit,) for p in survivors
-                        for bit in (False, True)]
+        if level < self.mastic.vidpf.BITS - 1:
+            self.prefixes = [p + (bit,) for p in survivors
+                             for bit in (False, True)]
         else:
-            heavy_hitters = survivors
-    return heavy_hitters
+            self.heavy_hitters = survivors
+        self.level += 1
+        if self.level >= self.mastic.vidpf.BITS or not self.prefixes:
+            self.done = True
+        return not self.done
+
+    def result(self) -> list:
+        return self.heavy_hitters
+
+    # -- checkpoint / resume ---------------------------------------
+
+    def to_bytes(self) -> bytes:
+        """Serialize the run between levels (collector state + both
+        carries + the rejection-fallback mask)."""
+        import io
+
+        from ..backend.incremental import carry_to_arrays
+
+        data = {
+            "meta": np.array(
+                [_CKPT_VERSION, self.level, int(self.done),
+                 0 if self.runner is None else 1,
+                 self.mastic.vidpf.BITS, len(self.reports)], np.int64),
+            "binding": _ckpt_binding(self.verify_key, self.ctx,
+                                     self.thresholds),
+            "prefixes": _paths_to_array(self.prefixes),
+            "heavy_hitters": _paths_to_array(self.heavy_hitters),
+            "prev_levels": np.array(
+                [p[0] for p in self.prev_agg_params], np.int64),
+            "prev_wc": np.array(
+                [p[2] for p in self.prev_agg_params], bool),
+        }
+        if self.prev_agg_params:
+            data["last_prefixes"] = _paths_to_array(
+                self.prev_agg_params[-1][1])
+        if self.runner is not None:
+            data["width"] = np.int64(self.runner.width)
+            data["fallback"] = self.runner.fallback
+            data.update(carry_to_arrays(self.runner.carries[0], "c0_"))
+            data.update(carry_to_arrays(self.runner.carries[1], "c1_"))
+        buf = io.BytesIO()
+        np.savez(buf, **data)
+        return buf.getvalue()
+
+    @classmethod
+    def from_bytes(cls, mastic: Mastic, ctx: bytes, thresholds: dict,
+                   reports: list, verify_key: bytes,
+                   data: bytes) -> "HeavyHittersRun":
+        """Restore a checkpointed run over the same report store."""
+        import io
+
+        from ..backend.incremental import (carry_from_arrays,
+                                           needed_paths)
+
+        arrays = np.load(io.BytesIO(data), allow_pickle=False)
+        (version, level, done, incremental, bits, num_reports) = \
+            [int(x) for x in arrays["meta"]]
+        if version != _CKPT_VERSION:
+            raise ValueError(f"unknown checkpoint version {version}")
+        if bits != mastic.vidpf.BITS or num_reports != len(reports):
+            raise ValueError("checkpoint does not match this "
+                             "instantiation / report store")
+        if not np.array_equal(np.asarray(arrays["binding"]),
+                              _ckpt_binding(verify_key, ctx,
+                                            thresholds)):
+            raise ValueError("checkpoint was taken under a different "
+                             "verify_key / ctx / thresholds")
+
+        run = cls(mastic, ctx, thresholds, reports,
+                  verify_key=verify_key, incremental=bool(incremental))
+        run.level = level
+        run.done = bool(done)
+        run.prefixes = _paths_from_array(arrays["prefixes"])
+        run.heavy_hitters = _paths_from_array(arrays["heavy_hitters"])
+        prev_levels = [int(x) for x in arrays["prev_levels"]]
+        prev_wc = [bool(x) for x in arrays["prev_wc"]]
+        last_prefixes: tuple = ()
+        if prev_levels:
+            last_prefixes = tuple(
+                _paths_from_array(arrays["last_prefixes"]))
+        # is_valid consumes only the weight-check flags and the last
+        # level; the last round's prefixes are kept exactly because
+        # they also determine the runner's carried paths.
+        run.prev_agg_params = [
+            (lvl, last_prefixes if i == len(prev_levels) - 1 else (),
+             wc)
+            for (i, (lvl, wc)) in enumerate(zip(prev_levels, prev_wc))
+        ]
+        if run.runner is not None and prev_levels:
+            from ..backend.incremental import IncrementalMastic
+
+            runner = run.runner
+            width = int(arrays["width"])
+            if width != runner.width:
+                # Re-point the engine at the stored width directly —
+                # the freshly-initialized carries are about to be
+                # replaced wholesale, so _grow's padding would be
+                # wasted device work.
+                runner.width = width
+                runner.engine = IncrementalMastic(runner.bm, width)
+                runner._eval_fn = None
+                runner._agg_fn = None
+            runner.fallback = np.asarray(arrays["fallback"], bool)
+            runner.carries = [
+                carry_from_arrays(arrays, "c0_"),
+                carry_from_arrays(arrays, "c1_"),
+            ]
+            carried = needed_paths(last_prefixes, prev_levels[-1])
+            runner.carried_paths = carried
+            runner.prev_paths = carried[prev_levels[-1]]
+        return run
 
 
 class _IncrementalRunner:
@@ -148,14 +373,21 @@ class _IncrementalRunner:
     round program."""
 
     def __init__(self, bm: BatchedMastic, verify_key: bytes, ctx: bytes,
-                 batch: ReportBatch, width: int = 8):
+                 batch: ReportBatch, reports: Optional[list] = None,
+                 width: int = 8):
         from ..backend.incremental import IncrementalMastic
 
         self.bm = bm
         self.verify_key = verify_key
         self.ctx = ctx
         self.batch = batch
+        self.reports = reports
         self.num_reports = int(batch.nonces.shape[0])
+        # Reports whose XOF rejection sampling fired at some round:
+        # their device carry holds garbage from that round onward, so
+        # they are excluded from every subsequent device aggregate and
+        # recomputed through the scalar layer each round instead.
+        self.fallback = np.zeros(self.num_reports, bool)
         self.width = max(4, width)
         self.engine = IncrementalMastic(bm, self.width)
         (self.ext_rk, self.conv_rk) = jax.jit(
@@ -233,7 +465,7 @@ class _IncrementalRunner:
         (c0, c1, out0, out1, accept, ok) = eval_fn(
             self.carries[0], self.carries[1], round_inputs(plan),
             self.ext_rk, self.conv_rk, self.batch.cws)
-        _require_ok(ok)
+        self.fallback |= ~np.asarray(ok)
         self.carries = [c0, c1]
         self.carried_paths = plan.needed
         self.prev_paths = plan.needed[level]
@@ -246,13 +478,17 @@ class _IncrementalRunner:
             (_agg0, _agg1, wc_accept, wc_ok) = _round_fn(
                 self.bm, self.verify_key, self.ctx, agg_param)(
                 self.batch)
-            _require_ok(wc_ok)
+            self.fallback |= ~np.asarray(wc_ok)
             accept = jnp.asarray(accept) & jnp.asarray(wc_accept)
 
-        (agg0, agg1) = agg_fn(out0, out1, jnp.asarray(accept))
+        accept = jnp.asarray(accept) & jnp.asarray(~self.fallback)
+        (agg0, agg1) = agg_fn(out0, out1, accept)
         rows = len(prefixes) * (1 + self.bm.m.flp.OUTPUT_LEN)
         agg_shares = [
             self.bm.agg_share_to_host(a[:rows]) for a in (agg0, agg1)
         ]
-        num = int(np.asarray(accept).sum())
+        accept = np.asarray(accept).copy()
+        splice_rejected(self.bm.m, self.verify_key, self.ctx, agg_param,
+                        self.reports, ~self.fallback, accept, agg_shares)
+        num = int(accept.sum())
         return self.bm.m.unshard(agg_param, agg_shares, num)

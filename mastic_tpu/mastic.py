@@ -54,6 +54,14 @@ MasticPrepShare: TypeAlias = tuple[
 MasticPrepMessage: TypeAlias = Optional[bytes]  # FLP joint rand seed
 
 
+class ReportRejected(Exception):
+    """A report failed one of the protocol's validity checks (VIDPF
+    eval proof, FLP decide, or joint-rand confirmation).  Distinct
+    from programming/infrastructure errors so callers that treat
+    rejection as a per-report verdict (e.g. the XOF rejection-sampling
+    fallback) don't swallow real bugs."""
+
+
 class Mastic(
         Generic[W, R, F],
         Vdaf[
@@ -276,7 +284,7 @@ class Mastic(
 
         # VIDPF validity: both parties must derive identical proofs.
         if eval_proof_0 != eval_proof_1:
-            raise Exception("VIDPF verification failed")
+            raise ReportRejected("VIDPF verification failed")
 
         if not do_weight_check:
             return None
@@ -286,7 +294,7 @@ class Mastic(
         # FLP validity.
         verifier = vec_add(verifier_share_0, verifier_share_1)
         if not self.flp.decide(verifier):
-            raise Exception("FLP verification failed")
+            raise ReportRejected("FLP verification failed")
 
         if self.flp.JOINT_RAND_LEN == 0:
             return None
@@ -302,7 +310,7 @@ class Mastic(
             if prep_msg is None:
                 raise ValueError("expected joint rand confirmation")
             if prep_msg != joint_rand_seed:
-                raise Exception("joint rand confirmation failed")
+                raise ReportRejected("joint rand confirmation failed")
         return truncated_out_share
 
     # -- aggregation & collection (reference mastic.py:379-411) ----

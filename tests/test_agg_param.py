@@ -1,0 +1,46 @@
+"""Aggregation-parameter policy: the `is_valid` matrix.
+
+Same cases as the reference policy suite
+(/root/reference/poc/tests/test_mastic.py:11-68): the weight check
+happens exactly once and on the first round, and the level strictly
+increases between rounds (reference mastic.py:187-203; spec
+draft-mouris-cfrg-mastic.md:1175-1207).
+"""
+
+import pytest
+
+from mastic_tpu import MasticCount
+
+MASTIC = MasticCount(4)
+
+CASES = [
+    # (expected, agg_param, previous_agg_params)
+    # Weight check on the first round, at any level.
+    (True, (0, ((False,),), True), []),
+    (True, (2, ((True, False, False),), True), []),
+    # Invalid: the weight check never happens.
+    (False, (0, ((False,),), False), []),
+    # Later round without a weight check, after a checked first round.
+    (True, (1, ((False, True),), False),
+     [(0, ((False,),), True)]),
+    # Invalid: the weight check happens twice.
+    (False, (1, ((False, True),), True),
+     [(0, ((False,),), True)]),
+    # Invalid: the weight check happens, but not on the first round.
+    (False, (1, ((False, True),), True),
+     [(0, ((False,),), False)]),
+    # Invalid: the weight check never happens (two rounds in).
+    (False, (1, ((True, False),), False),
+     [(0, ((False,),), False)]),
+    # Invalid: the level decreases.
+    (False, (1, ((True, False),), False),
+     [(2, ((True, False, False),), True)]),
+    # Invalid: the level repeats.
+    (False, (1, ((True, False),), False),
+     [(1, ((False, True),), True)]),
+]
+
+
+@pytest.mark.parametrize(("expected", "agg_param", "previous"), CASES)
+def test_is_valid_matrix(expected, agg_param, previous):
+    assert MASTIC.is_valid(agg_param, previous) is expected

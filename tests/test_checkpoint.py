@@ -1,0 +1,88 @@
+"""Checkpoint/resume of a heavy-hitters run (SURVEY.md §5).
+
+A run is stopped between levels, serialized to bytes, restored into
+fresh objects, and must finish with exactly the result of the
+uninterrupted run — including the incremental carries (the
+cache-across-rounds state the reference names at
+/root/reference/poc/vidpf.py:243-245).
+"""
+
+import numpy as np
+
+from mastic_tpu import MasticCount
+from mastic_tpu.backend.incremental import (carry_from_arrays,
+                                            carry_to_arrays)
+from mastic_tpu.drivers import (HeavyHittersRun, compute_heavy_hitters,
+                                get_reports_from_measurements)
+
+BITS = 4
+CTX = b"checkpoint test"
+VERIFY_KEY = bytes(range(32))
+THRESHOLDS = {"default": 2}
+
+
+def _reports(mastic):
+    values = [0b1001, 0b0000, 0b0000, 0b1001, 0b1100, 0b0011, 0b1111,
+              0b1111]
+    measurements = [
+        (mastic.vidpf.test_index_from_int(v, BITS), 1) for v in values
+    ]
+    return get_reports_from_measurements(mastic, CTX, measurements)
+
+
+def test_stop_restore_matches_uninterrupted():
+    mastic = MasticCount(BITS)
+    reports = _reports(mastic)
+    want = compute_heavy_hitters(mastic, CTX, THRESHOLDS, reports,
+                                 verify_key=VERIFY_KEY)
+    assert want  # non-trivial example
+
+    for stop_after in (1, 2):
+        run = HeavyHittersRun(mastic, CTX, THRESHOLDS, reports,
+                              verify_key=VERIFY_KEY)
+        for _ in range(stop_after):
+            assert run.step()
+        blob = run.to_bytes()
+        del run
+
+        resumed = HeavyHittersRun.from_bytes(
+            mastic, CTX, THRESHOLDS, reports, VERIFY_KEY, blob)
+        while resumed.step():
+            pass
+        assert resumed.result() == want
+
+
+def test_checkpoint_rejects_mismatched_store():
+    mastic = MasticCount(BITS)
+    reports = _reports(mastic)
+    run = HeavyHittersRun(mastic, CTX, THRESHOLDS, reports,
+                          verify_key=VERIFY_KEY)
+    run.step()
+    blob = run.to_bytes()
+    try:
+        HeavyHittersRun.from_bytes(mastic, CTX, THRESHOLDS,
+                                   reports[:-1], VERIFY_KEY, blob)
+        raise AssertionError("expected ValueError")
+    except ValueError:
+        pass
+    # A different verify_key (or ctx) must fail loudly, not silently
+    # reject every report.
+    other_key = bytes(31) + b"\x01"
+    try:
+        HeavyHittersRun.from_bytes(mastic, CTX, THRESHOLDS, reports,
+                                   other_key, blob)
+        raise AssertionError("expected ValueError")
+    except ValueError:
+        pass
+
+
+def test_carry_arrays_roundtrip():
+    mastic = MasticCount(BITS)
+    reports = _reports(mastic)
+    run = HeavyHittersRun(mastic, CTX, THRESHOLDS, reports,
+                          verify_key=VERIFY_KEY)
+    run.step()
+    carry = run.runner.carries[0]
+    restored = carry_from_arrays(carry_to_arrays(carry))
+    for (a, b) in zip(carry, restored):
+        assert np.array_equal(np.asarray(a), np.asarray(b))

@@ -80,3 +80,29 @@ def test_communication_report_matches_formulas():
     hist = sizes["MasticHistogram(32, 100, 10)"]
     assert hist["public_share"] == 8 + 32 * (16 + 101 * 16 + 32)
     assert hist["helper_share"] == 16 + 32 + 32
+
+
+def test_communication_report_comparison_story():
+    """The reference's headline comparisons (examples.py:263-364),
+    reproduced from published vdaf-13 constants (SURVEY.md §2.2)."""
+    sizes = communication_report(print_fn=lambda *_: None)
+    poplar = sizes["Poplar1(256)"]
+    # vdaf-13 §8 structure: 64 ctrl bytes + 256 seed CWs + 255 inner +
+    # 1 leaf payload CW; leader carries the explicit (a, b, c) sketch
+    # correlation.
+    assert poplar["public_share"] == 64 + 256 * 16 + 255 * 16 + 64
+    assert poplar["leader_share"] == 48 + 3 * 255 * 8 + 3 * 32
+    assert poplar["upload"] == 8304 + 6264 + 48
+    # Mastic's upload is within ~15% of Poplar1's while also carrying
+    # a weight and needing one prep round instead of two.
+    ratio = sizes["mastic_count_vs_poplar1_upload"]
+    assert 1.0 < ratio < 1.2
+
+    prio3 = sizes["Prio3Histogram(10000, 100)"]
+    # Histogram(10000, 100): 100 Mul-gadget calls -> PROOF_LEN
+    # 2*100 + 2*(next_pow_2(101) - 1) + 1 = 455 over Field128.
+    assert prio3["leader_share"] == (10000 + 455) * 16 + 32
+    assert prio3["upload"] == 64 + 167312 + 64
+    # Attribute-metrics mode: Mastic's upload is ~3x smaller than the
+    # flat Prio3 histogram over the product space.
+    assert sizes["prio3_vs_mastic_histogram_upload"] > 3.0

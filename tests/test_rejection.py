@@ -1,0 +1,144 @@
+"""The XOF rejection-sampling fallback (vdaf-13 §6.2).
+
+The batched sampler is exact only when no sampled element falls
+outside the field; lanes where one does (probability ~2^-32 per
+element for Field64) are flagged via the `ok` mask and must be
+recomputed through the scalar layer, whose sampler implements the true
+rejection loop (reference consumption
+/root/reference/poc/vidpf.py:352-364).
+
+A real rejection needs ~2^32 trials to find, so these tests force the
+mask instead: `sample_vec` is monkeypatched to flag chosen report
+lanes, and the drivers must produce output identical to the unpatched
+run over the same reports (the device values of a flagged lane are
+still valid here, and the scalar fallback recomputes exactly those
+values — so agreement proves the splice is wired end-to-end).  The
+mask predicate itself is unit-tested against crafted out-of-range
+bytes below.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mastic_tpu.backend.mastic_jax as mastic_jax
+import mastic_tpu.backend.vidpf_jax as vidpf_jax
+import mastic_tpu.backend.xof_jax as xof_jax
+from mastic_tpu import MasticCount, MasticSum
+from mastic_tpu.drivers import (aggregate_by_attribute,
+                                compute_heavy_hitters,
+                                get_reports_from_measurements,
+                                hash_attribute)
+from mastic_tpu.field import Field64, Field128
+from mastic_tpu.ops.field_jax import spec_for
+
+
+def _force_reject(monkeypatch, lanes):
+    """Patch sample_vec so the chosen report lanes always read as
+    rejected (the leading batch axis is the report axis at every call
+    site in the aggregation path)."""
+    real = xof_jax.sample_vec
+    lanes = jnp.asarray(lanes)
+
+    def fake(spec, stream, length, offset=0):
+        (limbs, ok) = real(spec, stream, length, offset)
+        bad = jnp.zeros((ok.shape[0],), bool).at[lanes].set(True)
+        return (limbs, ok & ~bad.reshape((-1,) + (1,) * (ok.ndim - 1)))
+
+    for mod in (vidpf_jax, mastic_jax):
+        monkeypatch.setattr(mod, "sample_vec", fake)
+
+
+def test_heavy_hitters_with_forced_rejections(monkeypatch):
+    bits = 4
+    mastic = MasticCount(bits)
+    ctx = b"rejection hh"
+    values = [0b1001, 0b0000, 0b0000, 0b1001, 0b1100, 0b0011]
+    measurements = [
+        (mastic.vidpf.test_index_from_int(v, bits), 1) for v in values
+    ]
+    reports = get_reports_from_measurements(mastic, ctx, measurements)
+    verify_key = bytes(range(32))
+    thresholds = {"default": 2}
+
+    want = compute_heavy_hitters(mastic, ctx, thresholds, reports,
+                                 verify_key=verify_key)
+    assert want  # non-trivial example
+
+    _force_reject(monkeypatch, [0, 3])
+    for incremental in (True, False):
+        got = compute_heavy_hitters(mastic, ctx, thresholds, reports,
+                                    verify_key=verify_key,
+                                    incremental=incremental)
+        assert got == want
+
+
+def test_attribute_metrics_with_forced_rejection(monkeypatch):
+    mastic = MasticSum(8, 3)
+    ctx = b"rejection attrs"
+    votes = [("Greece", 1), ("United States", 2), ("Greece", 3),
+             ("India", 1)]
+    reports = get_reports_from_measurements(
+        mastic, ctx,
+        [(hash_attribute(mastic, a), v) for (a, v) in votes])
+    verify_key = bytes(range(32))
+    attributes = ["Greece", "Mexico", "United States"]
+
+    want = aggregate_by_attribute(mastic, ctx, attributes, reports,
+                                  verify_key=verify_key)
+    _force_reject(monkeypatch, [2])
+    got = aggregate_by_attribute(mastic, ctx, attributes, reports,
+                                 verify_key=verify_key)
+    assert got == want == [("Greece", 4), ("Mexico", 0),
+                           ("United States", 2)]
+
+
+def test_fallback_requires_host_reports(monkeypatch):
+    from mastic_tpu.backend.mastic_jax import BatchedMastic
+    from mastic_tpu.drivers.heavy_hitters import run_round
+
+    mastic = MasticCount(2)
+    ctx = b"rejection guard"
+    measurements = [(mastic.vidpf.test_index_from_int(0b10, 2), 1)]
+    reports = get_reports_from_measurements(mastic, ctx, measurements)
+    bm = BatchedMastic(mastic)
+    batch = bm.marshal_reports(reports)
+    _force_reject(monkeypatch, [0])
+    with pytest.raises(ValueError, match="scalar fallback"):
+        run_round(bm, bytes(32), ctx, (0, ((False,), (True,)), True),
+                  batch)
+
+
+@pytest.mark.parametrize("field", [Field64, Field128])
+def test_limb_mask_flags_out_of_range_bytes(field):
+    """The device in-range predicate matches `value < p` exactly at
+    the boundary (scalar rejection predicate: mastic_tpu/xof.py)."""
+    spec = spec_for(field)
+    size = field.ENCODED_SIZE
+    cases = [
+        (field.MODULUS - 1, True),
+        (field.MODULUS, False),
+        ((1 << (8 * size)) - 1, False),
+        (0, True),
+    ]
+    data = jnp.asarray(np.stack([
+        np.frombuffer(v.to_bytes(size, "little"), np.uint8)
+        for (v, _) in cases
+    ]))
+    (limbs, ok) = spec.limbs_from_le_bytes(data)
+    assert list(np.asarray(ok)) == [want for (_, want) in cases]
+    assert spec.limbs_to_int(np.asarray(limbs)[0]) == field.MODULUS - 1
+
+
+def test_sample_vec_mask_reduces_over_elements():
+    """sample_vec's per-lane mask is the AND over that lane's sampled
+    elements."""
+    spec = spec_for(Field64)
+    good = (1).to_bytes(8, "little")
+    bad = ((1 << 64) - 1).to_bytes(8, "little")
+    stream = jnp.asarray(np.stack([
+        np.frombuffer(good + good, np.uint8),
+        np.frombuffer(good + bad, np.uint8),
+    ]))
+    (_limbs, ok) = xof_jax.sample_vec(spec, stream, 2)
+    assert list(np.asarray(ok)) == [True, False]
