@@ -1,0 +1,40 @@
+# CI gates (reference parity: unittest + strict mypy + examples,
+# /root/reference/.github/workflows/test.yml:33-43, lint at
+# lint-python.yml:24-40).
+#
+#   make ci      fast gate: lint + typecheck (if mypy installed) +
+#                fast-tier tests (scalar + kernel smokes; <5 min cold
+#                on a 1-CPU host with a warm compile cache)
+#   make test    full suite (adds the slow differential/adversarial/
+#                driver tiers)
+#   make bench   single-chip benchmark (prints one JSON line)
+
+PY ?= python
+
+.PHONY: ci lint typecheck test-fast test test-slow bench
+
+ci: lint typecheck test-fast
+
+lint:
+	$(PY) tools/lint.py
+
+typecheck:
+	@if $(PY) -c "import mypy" 2>/dev/null; then \
+		$(PY) -m mypy --config-file mypy.ini mastic_tpu; \
+	else \
+		echo "typecheck: mypy not installed in this image;" \
+		     "mypy.ini is the CI configuration (strict on the" \
+		     "scalar layer) - skipping"; \
+	fi
+
+test-fast:
+	$(PY) -m pytest tests/ -q -m "not slow"
+
+test-slow:
+	$(PY) -m pytest tests/ -q -m "slow"
+
+test:
+	$(PY) -m pytest tests/ -q
+
+bench:
+	$(PY) bench.py

@@ -1,29 +1,42 @@
 """Benchmark: steady-state VIDPF evaluation throughput on one chip.
 
 Prints ONE JSON line on stdout:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
+   "configs": {...}}
 
-The metric is the BASELINE.json north star — VIDPF node evaluations
-per second per chip at 256-bit tree depth, where one node evaluation
-is the full extend + correct + convert + node-proof pipeline of
-/root/reference/poc/vidpf.py:281-325 (2 fixed-key-AES blocks + 2 AES
-convert blocks + 1 TurboSHAKE-128 hash per node, reference op model in
-BASELINE.md).  The reference publishes no timing numbers, so
-vs_baseline compares against this repo's own scalar CPU reference
-layer (the same byte-exact math the reference's Python PoC runs),
-measured in-process.
+The headline metric is the BASELINE.json north star — VIDPF node
+evaluations per second per chip at 256-bit tree depth, where one node
+evaluation is the full extend + correct + convert + node-proof
+pipeline of /root/reference/poc/vidpf.py:281-325 (2 fixed-key-AES
+blocks + 2 AES convert blocks + 1 TurboSHAKE-128 hash per node,
+reference op model in BASELINE.md / PERF.md).  The reference publishes
+no timing numbers, so vs_baseline compares against this repo's own
+scalar CPU reference layer (the same byte-exact math the reference's
+Python PoC runs), measured in-process.
 
 Shapes mimic the heavy-hitters steady state: a pruned frontier of
 constant width marching down a 256-level tree; each timed step is one
 tree level over (reports x frontier) with a traced node binder so a
 single compiled program serves every level.
 
+`configs` carries the BASELINE.json per-config entries:
+  incremental_round      full steady-state incremental round (tree
+                         step + binder hashing + eval proof + masked
+                         aggregation; backend/incremental.py) at the
+                         headline shape — rounds/s and evals/s
+  prep_round_p50_ms      p50 single-round latency of the same program
+                         (includes host dispatch + tunnel RTT)
+  histogram_f128_b64     MasticHistogram(16, 4) @ BITS=64 — Field128
+                         limb kernels + device FLP weight check
+  sumvec1024_f128_b128   MasticSumVec(1024, 1, 32) @ BITS=128 —
+                         huge-payload convert; reported as payload
+                         bytes/s next to evals/s
+
 Fail-open design: every phase (import / device / scalar baseline /
-tiny sanity / compile / warmup / measure) stamps progress to stderr
-and updates a shared partial-result record; the watchdog prints the
-best measurement completed so far (tiny-shape rate if the full shape
-never finished, scalar baseline if the chip never came up) instead of
-a bare zero, with the failing phase named in "error".
+tiny sanity / compile / warmup / measure / each config) stamps
+progress to stderr and updates a shared partial-result record; the
+watchdog prints the best measurement completed so far instead of a
+bare zero, with the failing phase named in "error".
 """
 
 import argparse
@@ -169,6 +182,227 @@ class SteadyState:
         return self.evals_per_step * steps / dt
 
 
+def _synth_batch(bm, num_reports: int, rng):
+    """A synthetic ReportBatch with random bytes/limbs: the compute
+    cost of a round is input-independent (constant-time lane selects),
+    so throughput measured on garbage reports equals throughput on
+    real ones — only `accept` differs, and aggregation is masked
+    either way."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mastic_tpu.backend.mastic_jax import ReportBatch
+    from mastic_tpu.backend.vidpf_jax import BatchedCorrectionWords
+
+    m = bm.m
+    bits = m.vidpf.BITS
+    vl = m.vidpf.VALUE_LEN
+    n = bm.spec.num_limbs
+
+    def u8(*shape):
+        return jnp.asarray(rng.integers(0, 256, shape, np.uint8))
+
+    def limbs(*shape):
+        return jnp.asarray(rng.integers(0, 1 << 16, shape,
+                                        dtype=np.uint32))
+
+    use_jr = m.flp.JOINT_RAND_LEN > 0
+    return ReportBatch(
+        nonces=u8(num_reports, 16),
+        cws=BatchedCorrectionWords(
+            seed=u8(num_reports, bits, 16),
+            ctrl=jnp.asarray(rng.integers(0, 2, (num_reports, bits, 2))
+                             .astype(bool)),
+            w=limbs(num_reports, bits, vl, n),
+            proof=u8(num_reports, bits, 32)),
+        keys=u8(num_reports, 2, 16),
+        leader_proofs=limbs(num_reports, m.flp.PROOF_LEN, n),
+        helper_seeds=u8(num_reports, 32),
+        leader_seeds=u8(num_reports, 32) if use_jr else None,
+        peer_parts=tuple(u8(num_reports, 32) if use_jr else None
+                         for _ in range(2)))
+
+
+def bench_full_round(bm, num_reports: int, agg_param, steps: int,
+                     latency_samples: int = 11):
+    """Compile one full from-root round (both preps + checks + FLP on
+    weight-check rounds + masked aggregation), then measure chained
+    steady-state throughput and single-round p50 latency."""
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    batch = _synth_batch(bm, num_reports, rng)
+    vk = bytes(range(32))
+    fn = jax.jit(lambda b: bm.round_device(vk, b"bench", agg_param, b))
+    t0 = _time.perf_counter()
+    compiled = fn.lower(batch).compile()
+    compile_s = _time.perf_counter() - t0
+    out = compiled(batch)
+    jax.block_until_ready(out)
+
+    # Chained throughput: feed a rotated nonce array back in so each
+    # round depends on the last (defeats dispatch pipelining).
+    t0 = _time.perf_counter()
+    b = batch
+    for _ in range(steps):
+        (agg0, _agg1, _accept, _ok) = compiled(b)
+        b = b._replace(nonces=b.nonces.at[0, 0].set(
+            agg0[0, 0].astype("uint8")))
+    jax.block_until_ready(b.nonces)
+    per_round = (_time.perf_counter() - t0) / steps
+
+    lat = []
+    for _ in range(latency_samples):
+        t0 = _time.perf_counter()
+        out = compiled(batch)
+        jax.block_until_ready(out)
+        lat.append(_time.perf_counter() - t0)
+    p50_ms = sorted(lat)[len(lat) // 2] * 1e3
+    return (per_round, p50_ms, compile_s)
+
+
+def bench_incremental_round(bm, num_reports: int, frontier: int,
+                            bits: int, steps: int):
+    """Steady-state *incremental* round at a deep level: tree step for
+    both aggregators + binder hashing over the carried ancestor tree +
+    eval proof + masked aggregation (backend/incremental.py).  Carry
+    contents are random — cost is input-independent."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mastic_tpu.backend.incremental import (Carry,
+                                                IncrementalMastic,
+                                                RoundPlan,
+                                                needed_paths,
+                                                round_inputs)
+
+    level = bits - 56  # deep steady state; any level compiles the same
+    width = max(4, frontier)
+    half = width // 2
+    num_parents = frontier // 2
+    # Parents: distinct level-bit paths; candidates: both children.
+    parents = [
+        tuple(bool((i >> b) & 1) for b in range(level))
+        for i in range(num_parents)
+    ]
+    prefixes = tuple(p + (c,) for p in parents for c in (False, True))
+    carried = needed_paths(parents, level - 1)
+    plan = RoundPlan(prefixes, level, bits, width,
+                     carried[level - 1], carried)
+    rnd = round_inputs(plan)
+
+    engine = IncrementalMastic(bm, width)
+    rng = np.random.default_rng(8)
+    spec = bm.spec
+    vl = bm.m.vidpf.VALUE_LEN
+
+    def carry():
+        return Carry(
+            w=jnp.asarray(rng.integers(
+                0, 1 << 16, (num_reports, bits, width, vl,
+                             spec.num_limbs), dtype=np.uint32)),
+            proof=jnp.asarray(rng.integers(
+                0, 256, (num_reports, bits, width, 32), np.uint8)),
+            seed=jnp.asarray(rng.integers(
+                0, 256, (num_reports, width, 16), np.uint8)),
+            ctrl=jnp.asarray(rng.integers(
+                0, 2, (num_reports, width)).astype(bool)))
+
+    batch = _synth_batch(bm, num_reports, rng)
+    vk = bytes(range(32))
+    (ext_rk, conv_rk) = jax.jit(
+        lambda nn: bm.vidpf.roundkeys(b"bench", nn))(batch.nonces)
+
+    def both(c0, c1, r):
+        (c0, p0, out0, ok0) = engine.agg_round(
+            0, vk, b"bench", c0, r, ext_rk, conv_rk, batch.cws)
+        (c1, p1, out1, ok1) = engine.agg_round(
+            1, vk, b"bench", c1, r, ext_rk, conv_rk, batch.cws)
+        accept = jnp.all(p0 == p1, axis=-1)
+        return (c0, c1, bm.aggregate(out0, accept),
+                bm.aggregate(out1, accept))
+
+    fn = jax.jit(both, donate_argnums=(0, 1))
+    t0 = _time.perf_counter()
+    compiled = fn.lower(carry(), carry(), rnd).compile()
+    compile_s = _time.perf_counter() - t0
+    (c0, c1) = (carry(), carry())
+    (c0, c1, a0, _a1) = compiled(c0, c1, rnd)
+    jax.block_until_ready(a0)
+
+    t0 = _time.perf_counter()
+    for _ in range(steps):
+        (c0, c1, a0, _a1) = compiled(c0, c1, rnd)
+    jax.block_until_ready(a0)
+    per_round = (_time.perf_counter() - t0) / steps
+    evals = num_reports * 2 * num_parents * 2  # both aggregators
+    return (per_round, evals / per_round, compile_s)
+
+
+def run_configs(args) -> dict:
+    """The BASELINE.json per-config benches; each fails open into the
+    shared record."""
+    from mastic_tpu import MasticCount, MasticHistogram, MasticSumVec
+    from mastic_tpu.backend.mastic_jax import BatchedMastic
+
+    configs = PARTIAL.setdefault("configs", {})
+
+    # 1. Full steady-state incremental round at the headline shape.
+    stamp("config-incremental-round")
+    bm = BatchedMastic(MasticCount(args.bits))
+    (per_round, evals_s, compile_s) = bench_incremental_round(
+        bm, args.reports // 2, args.frontier, args.bits, args.steps)
+    configs["incremental_round"] = {
+        "instance": f"MasticCount({args.bits})",
+        "reports": args.reports // 2, "frontier": args.frontier,
+        "round_ms": round(per_round * 1e3, 2),
+        "node_evals_per_sec": round(evals_s, 1),
+        "compile_seconds": round(compile_s, 1),
+    }
+    stamp("config-incremental-done", evals_s=f"{evals_s:.0f}")
+
+    # 2. Histogram Field128 @ BITS=64: full round incl. device FLP.
+    stamp("config-histogram-f128")
+    bmh = BatchedMastic(MasticHistogram(64, 16, 4))
+    agg_param = (0, ((False,), (True,)), True)
+    (per_round, p50_ms, compile_s) = bench_full_round(
+        bmh, 2048, agg_param, max(4, args.steps // 4))
+    configs["histogram_f128_b64"] = {
+        "instance": "MasticHistogram(bits=64, length=16, chunk=4)",
+        "reports": 2048, "round": "level 0 + FLP weight check",
+        "round_ms": round(per_round * 1e3, 2),
+        "reports_per_sec": round(2048 / per_round, 1),
+        "prep_round_p50_ms": round(p50_ms, 2),
+        "compile_seconds": round(compile_s, 1),
+    }
+    stamp("config-histogram-done",
+          rps=f"{2048 / per_round:.0f}")
+
+    # 3. SumVec(1024) Field128 @ BITS=128: huge-payload convert.
+    stamp("config-sumvec-f128")
+    bmv = BatchedMastic(MasticSumVec(128, 1024, 1, 32))
+    sv = SteadyState(bmv, 128, 8, 128)
+    sv_compile = sv.compile()
+    sv.run(1)
+    rate = sv.run(max(4, args.steps // 4))
+    payload = bmv.m.vidpf.VALUE_LEN * bmv.m.field.ENCODED_SIZE
+    configs["sumvec1024_f128_b128"] = {
+        "instance": "MasticSumVec(bits=128, length=1024, chunk=32)",
+        "reports": 128, "frontier": 8,
+        "node_evals_per_sec": round(rate, 1),
+        "payload_bytes_per_sec": round(rate * payload, 1),
+        "compile_seconds": round(sv_compile, 1),
+    }
+    stamp("config-sumvec-done", rate=f"{rate:.0f}")
+    return configs
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--reports", type=int, default=4096)
@@ -177,7 +411,9 @@ def main():
     parser.add_argument("--bits", type=int, default=256)
     parser.add_argument("--cpu", action="store_true",
                         help="force the CPU backend (local sanity)")
-    parser.add_argument("--watchdog", type=float, default=900.0)
+    parser.add_argument("--headline-only", action="store_true",
+                        help="skip the per-config benches")
+    parser.add_argument("--watchdog", type=float, default=1500.0)
     args = parser.parse_args()
 
     timer = _watchdog(args.watchdog)
@@ -225,7 +461,6 @@ def main():
     full.run(2)
     stamp("measure")
     rate = full.run(args.steps)
-    timer.cancel()
 
     PARTIAL.pop("note", None)
     PARTIAL["value"] = round(rate, 1)
@@ -233,6 +468,14 @@ def main():
     PARTIAL["compile_seconds"] = round(compile_s, 1)
     PARTIAL["reports"] = args.reports
     PARTIAL["frontier"] = args.frontier
+
+    if not args.headline_only:
+        try:
+            run_configs(args)
+        except Exception as exc:  # fail open per config
+            PARTIAL.setdefault("configs", {})["error"] = \
+                f"{type(exc).__name__}: {exc}"
+    timer.cancel()
     stamp("done", rate=f"{rate:.0f}")
     emit()
 

@@ -252,56 +252,117 @@ class BatchedMastic:
                                        levels[0].w[:, 1])
             if agg_id == 1:
                 beta_share = self.spec.neg(beta_share)
-            (query_rand, qok) = self.query_rand(verify_key, ctx, nonces,
-                                                level)
-            ok = ok & qok
-            expanded_proof = proof_shares
-            if agg_id == 1:
-                assert seeds is not None
-                (expanded_proof, pok) = self.helper_proof_share(ctx,
-                                                                seeds)
-                ok = ok & pok
-            joint_rand = None
-            if self.m.flp.JOINT_RAND_LEN > 0:
-                assert seeds is not None
-                assert peer_jr_parts is not None
-                jr_part = self.joint_rand_part(
-                    ctx, seeds, beta_share[..., 1:, :], nonces)
-                if agg_id == 0:
-                    jr_seed = self.joint_rand_seed(ctx, jr_part,
-                                                   peer_jr_parts)
-                else:
-                    jr_seed = self.joint_rand_seed(ctx, peer_jr_parts,
-                                                   jr_part)
-                (joint_rand, jok) = self.joint_rand(ctx, jr_seed)
-                ok = ok & jok
-            # Device FLP query (scalar: mastic.py:250-256).
-            (verifier, vok) = self.bflp.query(
-                beta_share[..., 1:, :], expanded_proof, query_rand,
-                joint_rand, 2)
-            ok = ok & vok
+            (verifier, jr_part, jr_seed, wok) = self._weight_check(
+                agg_id, verify_key, ctx, level, nonces, beta_share,
+                proof_shares, seeds, peer_jr_parts)
+            ok = ok & wok
 
         return BatchedPrep(
             out_share=out_share, eval_proof=eval_proof,
             verifier=verifier, joint_rand_part=jr_part,
             joint_rand_seed=jr_seed, ok=ok)
 
+    def _weight_check(self, agg_id: int, verify_key: bytes, ctx: bytes,
+                      level: int, nonces: jax.Array,
+                      beta_share: jax.Array,
+                      proof_shares: Optional[jax.Array],
+                      seeds: Optional[jax.Array],
+                      peer_jr_parts: Optional[jax.Array]):
+        """One aggregator's FLP weight check over an (unnegated-sum
+        derived) beta share (scalar: mastic.py:234-256).  Returns
+        (verifier, joint_rand_part, joint_rand_seed, ok)."""
+        (query_rand, ok) = self.query_rand(verify_key, ctx, nonces,
+                                           level)
+        expanded_proof = proof_shares
+        if agg_id == 1:
+            assert seeds is not None
+            (expanded_proof, pok) = self.helper_proof_share(ctx, seeds)
+            ok = ok & pok
+        joint_rand = None
+        jr_part = None
+        jr_seed = None
+        if self.m.flp.JOINT_RAND_LEN > 0:
+            assert seeds is not None
+            assert peer_jr_parts is not None
+            jr_part = self.joint_rand_part(
+                ctx, seeds, beta_share[..., 1:, :], nonces)
+            if agg_id == 0:
+                jr_seed = self.joint_rand_seed(ctx, jr_part,
+                                               peer_jr_parts)
+            else:
+                jr_seed = self.joint_rand_seed(ctx, peer_jr_parts,
+                                               jr_part)
+            (joint_rand, jok) = self.joint_rand(ctx, jr_seed)
+            ok = ok & jok
+        # Device FLP query (scalar: mastic.py:250-256).
+        (verifier, vok) = self.bflp.query(
+            beta_share[..., 1:, :], expanded_proof, query_rand,
+            joint_rand, 2)
+        return (verifier, jr_part, jr_seed, ok & vok)
+
+    def weight_check_device(self, verify_key: bytes, ctx: bytes,
+                            level: int, batch: "ReportBatch",
+                            w0_pair: jax.Array, w1_pair: jax.Array):
+        """Both aggregators' FLP weight check from the two depth-0
+        payload shares each already holds (the incremental round-0
+        path: the tree program computed those rows, so no second
+        from-root eval is needed — contrast the reference, whose
+        prep re-derives them via get_beta_share, mastic.py:234-236).
+
+        w{a}_pair: aggregator a's unnegated depth-0 child payloads
+        (R, 2, VALUE_LEN, n) plain limbs.  Returns (checks, ok (R,))
+        where checks has per-verdict masks "weight_check" [+
+        "joint_rand"] — the eval-proof check belongs to the tree
+        round."""
+        results = []
+        ok = None
+        for (agg_id, w_pair) in ((0, w0_pair), (1, w1_pair)):
+            beta_share = self.spec.add(w_pair[:, 0], w_pair[:, 1])
+            if agg_id == 1:
+                beta_share = self.spec.neg(beta_share)
+            (verifier, _part, jr_seed, aok) = self._weight_check(
+                agg_id, verify_key, ctx, level, batch.nonces,
+                beta_share,
+                batch.leader_proofs if agg_id == 0 else None,
+                batch.leader_seeds if agg_id == 0
+                else batch.helper_seeds,
+                batch.peer_parts[agg_id])
+            results.append((verifier, jr_seed))
+            ok = aok if ok is None else ok & aok
+        verifier = self.spec.add(results[0][0], results[1][0])
+        checks = {"weight_check": self.bflp.decide(verifier)}
+        if results[0][1] is not None:
+            checks["joint_rand"] = jnp.all(
+                results[0][1] == results[1][1], axis=-1)
+        return (checks, ok)
+
     # -- round finish (scalar: mastic.py:284-331) ------------------
 
-    def accept_mask(self, prep0: BatchedPrep, prep1: BatchedPrep,
-                    do_weight_check: bool) -> jax.Array:
-        """Which reports pass the checks: eval proofs equal, FLP decide
-        over the summed verifier shares (weight-check rounds).
-        Joint-rand confirmation (prep_next) is seed equality, folded in
-        here for the batched round.  Fully on device, jittable."""
-        accept = jnp.all(prep0.eval_proof == prep1.eval_proof, axis=-1)
+    def accept_checks(self, prep0: BatchedPrep, prep1: BatchedPrep,
+                      do_weight_check: bool) -> dict:
+        """Per-check verdict masks: eval proofs equal, FLP decide over
+        the summed verifier shares (weight-check rounds), joint-rand
+        seed confirmation (prep_next semantics).  Keys present only
+        for checks this round runs.  Fully on device, jittable."""
+        checks = {"eval_proof": jnp.all(
+            prep0.eval_proof == prep1.eval_proof, axis=-1)}
         if do_weight_check:
             assert prep0.verifier is not None
             verifier = self.spec.add(prep0.verifier, prep1.verifier)
-            accept = accept & self.bflp.decide(verifier)
+            checks["weight_check"] = self.bflp.decide(verifier)
         if prep0.joint_rand_seed is not None:
-            accept = accept & jnp.all(
+            checks["joint_rand"] = jnp.all(
                 prep0.joint_rand_seed == prep1.joint_rand_seed, axis=-1)
+        return checks
+
+    def accept_mask(self, prep0: BatchedPrep, prep1: BatchedPrep,
+                    do_weight_check: bool) -> jax.Array:
+        """AND of accept_checks (the round's accept verdict)."""
+        checks = self.accept_checks(prep0, prep1, do_weight_check)
+        accept = checks["eval_proof"]
+        for (name, mask) in checks.items():
+            if name != "eval_proof":
+                accept = accept & mask
         return accept
 
     def aggregate(self, out_share: jax.Array,
@@ -355,6 +416,35 @@ class BatchedMastic:
             helper_seeds=jnp.asarray(helper_seeds),
             leader_seeds=leader_seeds, peer_parts=peer_parts)
 
+    def marshal_party_reports(self, agg_id: int, reports: list) -> dict:
+        """One party's view of the upload channel: reports
+        [(nonce, public_share, input_share)] where input_share is THIS
+        aggregator's MasticInputShare only (the process-separated
+        parties never see the peer's share).  Returns the keyword
+        arguments for `prep` plus the nonce/cw arrays."""
+        nonces = np.stack([np.frombuffer(n, np.uint8)
+                           for (n, _, _) in reports])
+        cws = self.vidpf.cws_from_host([ps for (_, ps, _) in reports])
+        keys = jnp.asarray(np.stack(
+            [np.frombuffer(sh[0], np.uint8) for (_, _, sh) in reports]))
+        out = {"nonces": jnp.asarray(nonces), "cws": cws, "keys": keys,
+               "proof_shares": None, "seeds": None,
+               "peer_jr_parts": None}
+        if agg_id == 0:
+            out["proof_shares"] = jnp.asarray(np.stack([
+                np.stack([self.spec.int_to_limbs(x.int())
+                          for x in sh[1]])
+                for (_, _, sh) in reports]))
+        if any(sh[2] is not None for (_, _, sh) in reports):
+            out["seeds"] = jnp.asarray(np.stack(
+                [np.frombuffer(sh[2], np.uint8)
+                 for (_, _, sh) in reports]))
+        if self.m.flp.JOINT_RAND_LEN > 0:
+            out["peer_jr_parts"] = jnp.asarray(np.stack(
+                [np.frombuffer(sh[3], np.uint8)
+                 for (_, _, sh) in reports]))
+        return out
+
     def prep_both(self, verify_key: bytes, ctx: bytes, agg_param,
                   batch: ReportBatch) -> tuple:
         """Run both aggregators' prep on a marshalled batch (the
@@ -383,10 +473,21 @@ class BatchedMastic:
         their contributions in (drivers/heavy_hitters.py:
         splice_rejected).
         """
+        return self.round_device_checks(verify_key, ctx, agg_param,
+                                        batch)[:4]
+
+    def round_device_checks(self, verify_key: bytes, ctx: bytes,
+                            agg_param, batch: ReportBatch) -> tuple:
+        """round_device plus the per-check verdict masks (for the
+        metrics layer): (agg0, agg1, accept, ok, checks)."""
         (_level, _prefixes, do_weight_check) = agg_param
         (p0, p1) = self.prep_both(verify_key, ctx, agg_param, batch)
-        accept = self.accept_mask(p0, p1, do_weight_check)
+        checks = self.accept_checks(p0, p1, do_weight_check)
+        accept = checks["eval_proof"]
+        for (name, mask) in checks.items():
+            if name != "eval_proof":
+                accept = accept & mask
         ok = p0.ok & p1.ok
         agg0 = self.aggregate(p0.out_share, accept & ok)
         agg1 = self.aggregate(p1.out_share, accept & ok)
-        return (agg0, agg1, accept, ok)
+        return (agg0, agg1, accept, ok, checks)

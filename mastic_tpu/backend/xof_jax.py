@@ -25,7 +25,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..common import to_le_bytes
-from ..ops.aes_jax import aes128_encrypt, aes128_key_schedule
+from ..ops.aes_jax import (aes128_encrypt, aes128_encrypt_bitsliced,
+                           aes128_key_schedule, bitslice_keys,
+                           bitslice_pack, bitslice_unpack)
 from ..ops.field_jax import FieldSpec
 from ..ops.keccak_jax import turbo_shake128
 
@@ -101,17 +103,47 @@ def fixed_key_blocks(round_keys: jax.Array, seeds: jax.Array,
     dims of `seeds` start with the dims of `round_keys` (one key
     schedule per report, many seeds per report).  Returns
     (B..., N..., num_blocks*16) uint8.
+
+    Large report batches take the bitsliced AES path (32 reports per
+    uint32 word along the batch axis); small ones keep the byte-plane
+    circuit, which has no packing overhead.  Both are byte-exact
+    (tests/test_ops_aes.py locks them against each other and the
+    scalar layer).
     """
     x = seeds[..., None, :] ^ jnp.asarray(_block_indices(num_blocks))
     lo = x[..., :8]
     hi = x[..., 8:]
     sigma = jnp.concatenate([hi, hi ^ lo], axis=-1)
-    # Broadcast round keys across the per-report seed dims + block dim.
-    extra = sigma.ndim - round_keys.ndim + 1
-    rk = round_keys.reshape(
-        round_keys.shape[:-2] + (1,) * extra + round_keys.shape[-2:])
-    out = aes128_encrypt(rk, sigma) ^ sigma
+    if (round_keys.ndim == 3 and seeds.ndim >= 2
+            and seeds.shape[0] == round_keys.shape[0]
+            and round_keys.shape[0] >= 32):
+        enc = _encrypt_bitsliced_reports(round_keys, sigma)
+    else:
+        # Broadcast round keys across per-report seed dims + block dim.
+        extra = sigma.ndim - round_keys.ndim + 1
+        rk = round_keys.reshape(
+            round_keys.shape[:-2] + (1,) * extra + round_keys.shape[-2:])
+        enc = aes128_encrypt(rk, sigma)
+    out = enc ^ sigma
     return out.reshape(out.shape[:-2] + (num_blocks * 16,))
+
+
+def _encrypt_bitsliced_reports(round_keys: jax.Array,
+                               sigma: jax.Array) -> jax.Array:
+    """AES over (R, N..., 16) blocks with per-report keys (R, 11, 16),
+    bit-transposed along the report axis (padded to a multiple of 32
+    with zero lanes, sliced back after)."""
+    r = sigma.shape[0]
+    pad = (-r) % 32
+    if pad:
+        sigma = jnp.concatenate(
+            [sigma, jnp.zeros((pad,) + sigma.shape[1:], _U8)])
+        round_keys = jnp.concatenate(
+            [round_keys, jnp.zeros((pad, 11, 16), _U8)])
+    planes = bitslice_pack(sigma)        # (8, 16, N..., W)
+    kp = bitslice_keys(round_keys)       # (11, 8, 16, W)
+    enc = bitslice_unpack(aes128_encrypt_bitsliced(kp, planes))
+    return enc[:r] if pad else enc
 
 
 def sample_vec(spec: FieldSpec, stream: jax.Array, length: int,

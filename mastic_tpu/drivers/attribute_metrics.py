@@ -30,9 +30,11 @@ def hash_attribute(mastic: Mastic, attribute: str) -> tuple:
 
 def aggregate_by_attribute(mastic: Mastic, ctx: bytes,
                            attributes: Sequence[str], reports: list,
-                           verify_key: Optional[bytes] = None) -> list:
+                           verify_key: Optional[bytes] = None,
+                           metrics_out: Optional[list] = None) -> list:
     """Aggregate `reports` grouped by the collector's attributes of
-    interest.  Returns [(attribute, aggregate)] pairs."""
+    interest.  Returns [(attribute, aggregate)] pairs; appends a
+    RoundMetrics record to `metrics_out` (observability, SURVEY §5)."""
     if verify_key is None:
         verify_key = gen_rand(mastic.VERIFY_KEY_SIZE)
     bm = BatchedMastic(mastic)
@@ -43,5 +45,6 @@ def aggregate_by_attribute(mastic: Mastic, ctx: bytes,
         raise ValueError("attribute hash collision; increase BITS")
     agg_param = (level, prefixes, True)
     assert mastic.is_valid(agg_param, [])
-    result = run_round(bm, verify_key, ctx, agg_param, batch, reports)
+    result = run_round(bm, verify_key, ctx, agg_param, batch, reports,
+                       metrics_out=metrics_out)
     return list(zip(attributes, result))

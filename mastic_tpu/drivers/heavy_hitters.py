@@ -22,6 +22,8 @@ import numpy as np
 
 from ..common import gen_rand, vec_add
 from ..mastic import Mastic, ReportRejected
+from ..metrics import (RoundMetrics, attribute_rejections,
+                       count_round_bytes, count_round_ops)
 from ..backend.mastic_jax import BatchedMastic, ReportBatch
 
 
@@ -58,8 +60,8 @@ def _round_fn(bm: BatchedMastic, verify_key: bytes, ctx: bytes,
     key = (verify_key, ctx, agg_param)
     fn = cache.get(key)
     if fn is None:
-        fn = jax.jit(lambda b: bm.round_device(verify_key, ctx,
-                                               agg_param, b))
+        fn = jax.jit(lambda b: bm.round_device_checks(verify_key, ctx,
+                                                      agg_param, b))
         cache[key] = fn
     return fn
 
@@ -67,23 +69,50 @@ def _round_fn(bm: BatchedMastic, verify_key: bytes, ctx: bytes,
 def run_round(bm: BatchedMastic, verify_key: bytes, ctx: bytes,
               agg_param, batch: ReportBatch,
               reports: Optional[list] = None,
-              accept_out: Optional[list] = None) -> list:
+              accept_out: Optional[list] = None,
+              metrics_out: Optional[list] = None) -> list:
     """One aggregation round on the batched backend: both preps, all
     checks (incl. the device FLP on weight-check rounds), masked
     aggregation, unshard.  Returns the per-prefix aggregate result;
-    appends the accept mask to `accept_out`.
+    appends the accept mask to `accept_out` and a RoundMetrics record
+    to `metrics_out`.
 
     `reports` is the host-side report list backing `batch`; it is only
     touched when XOF rejection sampling fires for some lane (the scalar
     fallback, see `splice_rejected`)."""
-    (agg0, agg1, accept, ok) = _round_fn(bm, verify_key, ctx,
-                                         agg_param)(batch)
+    from ..backend.schedule import LevelSchedule
+
+    (level, prefixes, do_weight_check) = agg_param
+    (agg0, agg1, accept, ok, checks) = _round_fn(bm, verify_key, ctx,
+                                                 agg_param)(batch)
     accept = np.asarray(accept).copy()
+    ok = np.asarray(ok)
+    num_reports = accept.shape[0]
+    sched = LevelSchedule(prefixes, level, bm.m.vidpf.BITS)
+    metrics = RoundMetrics(level=level, frontier_width=len(prefixes),
+                           padded_width=sched.total_nodes,
+                           reports_total=num_reports)
+    attribute_rejections(metrics, checks["eval_proof"],
+                         checks.get("weight_check"),
+                         checks.get("joint_rand"), device_ok=ok)
+    # From-root rounds evaluate the whole child grid; the beta shares
+    # on weight-check rounds reuse the depth-0 children (contrast the
+    # reference, whose get_beta_share re-evaluates them,
+    # mastic.py:235-236).
+    count_round_ops(metrics, bm.m, num_reports, sched.total_nodes,
+                    include_key_setup=True)
+    count_round_bytes(metrics, bm.m, agg_param, num_reports)
+    metrics.xof_fallbacks = int((~ok).sum())
+
     agg_shares = [bm.agg_share_to_host(a) for a in (agg0, agg1)]
     splice_rejected(bm.m, verify_key, ctx, agg_param, reports,
-                    np.asarray(ok), accept, agg_shares)
+                    ok, accept, agg_shares)
+    metrics.accepted = int(accept.sum())
+    metrics.rejected_fallback = int((~ok & ~accept).sum())
     if accept_out is not None:
         accept_out.append(accept)
+    if metrics_out is not None:
+        metrics_out.append(metrics)
     num = int(accept.sum())
     return bm.m.unshard(agg_param, agg_shares, num)
 
@@ -224,6 +253,7 @@ class HeavyHittersRun:
         self.prefixes: list = [(False,), (True,)]
         self.prev_agg_params: list = []
         self.heavy_hitters: list = []
+        self.metrics: list = []  # one RoundMetrics per completed level
         self.done = False
 
     def step(self) -> bool:
@@ -238,10 +268,12 @@ class HeavyHittersRun:
         agg_param = (level, tuple(self.prefixes), level == 0)
         assert self.mastic.is_valid(agg_param, self.prev_agg_params)
         if self.runner is not None:
-            agg_result = self.runner.round(agg_param)
+            agg_result = self.runner.round(agg_param,
+                                           metrics_out=self.metrics)
         else:
             agg_result = run_round(self.bm, self.verify_key, self.ctx,
-                                   agg_param, self.batch, self.reports)
+                                   agg_param, self.batch, self.reports,
+                                   metrics_out=self.metrics)
         self.prev_agg_params.append(agg_param)
 
         survivors = [
@@ -401,6 +433,7 @@ class _IncrementalRunner:
         self.prev_paths = None
         self._eval_fn = None
         self._agg_fn = None
+        self._wc_fns: dict = {}
 
     def _grow(self, width: int) -> None:
         from ..backend.incremental import Carry, IncrementalMastic
@@ -456,7 +489,17 @@ class _IncrementalRunner:
             self._agg_fn = jax.jit(agg)
         return (self._eval_fn, self._agg_fn)
 
-    def round(self, agg_param) -> list:
+    def _wc_fn(self, level: int):
+        fn = self._wc_fns.get(level)
+        if fn is None:
+            (bm, vk, ctx) = (self.bm, self.verify_key, self.ctx)
+            fn = jax.jit(lambda b, w0, w1: bm.weight_check_device(
+                vk, ctx, level, b, w0, w1))
+            self._wc_fns[level] = fn
+        return fn
+
+    def round(self, agg_param,
+              metrics_out: Optional[list] = None) -> list:
         from ..backend.incremental import round_inputs
 
         (level, prefixes, do_weight_check) = agg_param
@@ -470,16 +513,36 @@ class _IncrementalRunner:
         self.carried_paths = plan.needed
         self.prev_paths = plan.needed[level]
 
+        metrics = RoundMetrics(level=level,
+                               frontier_width=len(prefixes),
+                               padded_width=self.width,
+                               reports_total=self.num_reports)
+        checks = {"eval_proof": np.asarray(accept)}
         if do_weight_check:
-            # The FLP weight check runs through the fused from-root
-            # round program, re-evaluating level 0 (2 nodes wide —
-            # negligible next to the deep levels) to reuse its
-            # query/decide pipeline; its accept is authoritative.
-            (_agg0, _agg1, wc_accept, wc_ok) = _round_fn(
-                self.bm, self.verify_key, self.ctx, agg_param)(
-                self.batch)
+            # FLP weight check on the depth-0 payload rows the tree
+            # program just computed (rows 0..1 of depth 0 are always
+            # the two root children) — a small FLP-only program, not a
+            # second from-root tree eval.
+            (wc_checks, wc_ok) = self._wc_fn(level)(
+                self.batch, c0.w[:, 0, :2], c1.w[:, 0, :2])
             self.fallback |= ~np.asarray(wc_ok)
+            checks.update({k: np.asarray(v)
+                           for (k, v) in wc_checks.items()})
+            wc_accept = np.asarray(wc_checks["weight_check"])
+            if "joint_rand" in wc_checks:
+                wc_accept = wc_accept & np.asarray(
+                    wc_checks["joint_rand"])
             accept = jnp.asarray(accept) & jnp.asarray(wc_accept)
+        attribute_rejections(metrics, checks["eval_proof"],
+                             checks.get("weight_check"),
+                             checks.get("joint_rand"),
+                             device_ok=~self.fallback)
+        # The incremental round extends only the surviving parents.
+        count_round_ops(metrics, self.bm.m, self.num_reports,
+                        2 * plan.parent_count,
+                        include_key_setup=(level == 0))
+        count_round_bytes(metrics, self.bm.m, agg_param,
+                          self.num_reports)
 
         accept = jnp.asarray(accept) & jnp.asarray(~self.fallback)
         (agg0, agg1) = agg_fn(out0, out1, accept)
@@ -490,5 +553,10 @@ class _IncrementalRunner:
         accept = np.asarray(accept).copy()
         splice_rejected(self.bm.m, self.verify_key, self.ctx, agg_param,
                         self.reports, ~self.fallback, accept, agg_shares)
+        metrics.accepted = int(accept.sum())
+        metrics.xof_fallbacks = int(self.fallback.sum())
+        metrics.rejected_fallback = int((self.fallback & ~accept).sum())
+        if metrics_out is not None:
+            metrics_out.append(metrics)
         num = int(accept.sum())
         return self.bm.m.unshard(agg_param, agg_shares, num)

@@ -1,0 +1,401 @@
+"""Process-separated aggregators: leader and helper as OS processes
+exchanging the real wire encodings over sockets.
+
+The reference PoC simulates all parties in one process
+(/root/reference/poc/examples.py:51-59); its wire *formats* are fully
+specified, though, and this module runs them over an actual transport:
+
+    collector ──spawn──> leader (agg 0)     helper (agg 1)
+        │ upload: nonce‖public share‖input share   (per party view)
+        │ round:  encoded agg param
+        │                  ▲
+        │   helper ──prep share blob──> leader
+        │   leader ──accept bitmap + prep msgs──> helper
+        │ agg share bytes ──> collector (leader adds the bitmap)
+
+Each party drives the *batched* backend for prep (one device program
+over its whole report batch) and the scalar layer for the per-report
+cross-party logic (prep_shares_to_prep / joint-rand confirmation),
+exactly the split a real deployment would have.  Lanes where XOF
+rejection sampling fires are recomputed through the party's own
+scalar path before the exchange, so the fallback never crosses a
+trust boundary.
+
+The DAP-style topology: the helper only talks to the leader for prep;
+the collector only sees aggregate shares (plus the leader's accept
+count) — reference README's deployment sketch and SURVEY.md §2.3's
+communication-backend plan.
+"""
+
+import json
+import socket
+import subprocess
+import sys
+from typing import Optional
+
+import numpy as np
+
+from .. import mastic as mastic_mod
+from ..mastic import Mastic, ReportRejected
+from .. import wire
+
+
+def instantiate(spec: dict) -> Mastic:
+    """{"class": "MasticCount", "args": [2]} -> instance."""
+    cls = getattr(mastic_mod, spec["class"])
+    return cls(*spec["args"])
+
+
+def _channel(sock: socket.socket):
+    return sock.makefile("rwb")
+
+
+class AggregatorParty:
+    """One aggregator's protocol engine (transport-agnostic)."""
+
+    def __init__(self, mastic: Mastic, agg_id: int, verify_key: bytes,
+                 ctx: bytes):
+        from ..backend.mastic_jax import BatchedMastic
+
+        self.m = mastic
+        self.agg_id = agg_id
+        self.verify_key = verify_key
+        self.ctx = ctx
+        self.bm = BatchedMastic(mastic)
+        self.reports: list = []
+        self.arrays: Optional[dict] = None
+        self._prep = None
+
+    # -- upload channel --------------------------------------------
+
+    def load_reports(self, blobs: list[bytes]) -> None:
+        self.reports = [wire.decode_report(self.m, self.agg_id, blob)
+                        for blob in blobs]
+        self.arrays = self.bm.marshal_party_reports(self.agg_id,
+                                                    self.reports)
+
+    # -- prep ------------------------------------------------------
+
+    def prep_blob(self, agg_param) -> bytes:
+        """Run the batched prep and encode this party's prep shares:
+        R fixed-size rows (eval proof ‖ [jr part] ‖ [verifier])."""
+        import jax
+
+        assert self.arrays is not None
+        a = self.arrays
+        bm = self.bm
+        fn = jax.jit(lambda n, c, k, p, s, j: bm.prep(
+            self.agg_id, self.verify_key, self.ctx, agg_param,
+            n, c, k, proof_shares=p, seeds=s, peer_jr_parts=j))
+        p = fn(a["nonces"], a["cws"], a["keys"], a["proof_shares"],
+               a["seeds"], a["peer_jr_parts"])
+        self._prep = self._scalar_fallback(agg_param, p)
+        return self._encode_prep(agg_param, self._prep)
+
+    def _scalar_fallback(self, agg_param, p):
+        """Recompute lanes where XOF rejection sampling fired through
+        this party's scalar layer (vdaf-13 §6.2 rejection loop) and
+        splice the exact rows in."""
+        ok = np.asarray(p.ok)
+        if ok.all():
+            return p
+        spec = self.bm.spec
+        out_share = np.asarray(p.out_share).copy()
+        eval_proof = np.asarray(p.eval_proof).copy()
+        verifier = (None if p.verifier is None
+                    else np.asarray(p.verifier).copy())
+        jr_part = (None if p.joint_rand_part is None
+                   else np.asarray(p.joint_rand_part).copy())
+        jr_seed = (None if p.joint_rand_seed is None
+                   else np.asarray(p.joint_rand_seed).copy())
+        for r in np.flatnonzero(~ok):
+            (nonce, public_share, input_share) = self.reports[r]
+            (state, share) = self.m.prep_init(
+                self.verify_key, self.ctx, self.agg_id, agg_param,
+                nonce, public_share, input_share)
+            (out, seed) = state
+            (proof, ver, part) = share
+            out_share[r] = [spec.int_to_limbs(x.int()) for x in out]
+            eval_proof[r] = np.frombuffer(proof, np.uint8)
+            if verifier is not None and ver is not None:
+                verifier[r] = [spec.int_to_limbs(x.int()) for x in ver]
+            if jr_part is not None and part is not None:
+                jr_part[r] = np.frombuffer(part, np.uint8)
+            if jr_seed is not None and seed is not None:
+                jr_seed[r] = np.frombuffer(seed, np.uint8)
+        return p._replace(
+            out_share=out_share, eval_proof=eval_proof,
+            verifier=verifier, joint_rand_part=jr_part,
+            joint_rand_seed=jr_seed)
+
+    def _encode_prep(self, agg_param, p) -> bytes:
+        (_level, _prefixes, do_weight_check) = agg_param
+        num = np.asarray(p.eval_proof).shape[0]
+        parts = [np.asarray(p.eval_proof)]
+        if do_weight_check:
+            if self.m.flp.JOINT_RAND_LEN > 0:
+                parts.append(np.asarray(p.joint_rand_part))
+            ver = np.asarray(self.bm.spec.plain_to_le_bytes(
+                p.verifier)).reshape(num, -1)
+            parts.append(ver)
+        return np.concatenate(parts, axis=-1).tobytes()
+
+    # -- leader: the prep-share exchange ---------------------------
+
+    def resolve(self, agg_param, peer_blob: bytes) -> tuple:
+        """Leader side of prep_shares_to_prep over the report batch:
+        returns (accept bitmap bytes, prep-msg blob)."""
+        (_level, _prefixes, _wc) = agg_param
+        size = wire.prep_share_size(self.m, agg_param)
+        own_blob = self._encode_prep(agg_param, self._prep)
+        num = len(self.reports)
+        accept = np.zeros(num, bool)
+        use_jr = (self.m.flp.JOINT_RAND_LEN > 0 and agg_param[2])
+        jr_seed = (None if self._prep.joint_rand_seed is None
+                   else np.asarray(self._prep.joint_rand_seed))
+        msgs = []
+        for r in range(num):
+            own = wire.decode_prep_share(
+                self.m, agg_param, own_blob[r * size:(r + 1) * size])
+            peer = wire.decode_prep_share(
+                self.m, agg_param, peer_blob[r * size:(r + 1) * size])
+            try:
+                prep_msg = self.m.prep_shares_to_prep(
+                    self.ctx, agg_param, [own, peer])
+            except ReportRejected:
+                msgs.append(b"")
+                continue
+            # The leader's own joint-rand confirmation (prep_next
+            # semantics) — the helper runs the same check in confirm().
+            if use_jr:
+                assert jr_seed is not None
+                if prep_msg != jr_seed[r].tobytes():
+                    msgs.append(b"")
+                    continue
+            accept[r] = True
+            msgs.append(prep_msg or b"")
+        bitmap = np.packbits(accept, bitorder="little").tobytes()
+        blob = b"".join(wire.frame(m) for m in msgs)
+        return (accept, bitmap + blob)
+
+    def confirm(self, agg_param, resolution: bytes) -> np.ndarray:
+        """Helper side: parse the leader's bitmap + prep msgs, run the
+        joint-rand confirmation (prep_next semantics) per report."""
+        num = len(self.reports)
+        nbytes = (num + 7) // 8
+        accept = np.unpackbits(
+            np.frombuffer(resolution[:nbytes], np.uint8),
+            bitorder="little")[:num].astype(bool)
+        rest = resolution[nbytes:]
+        use_jr = (self.m.flp.JOINT_RAND_LEN > 0 and agg_param[2])
+        jr_seed = (None if self._prep.joint_rand_seed is None
+                   else np.asarray(self._prep.joint_rand_seed))
+        for r in range(num):
+            (msg, rest) = wire.unframe(rest)
+            if not accept[r]:
+                continue
+            if use_jr:
+                assert jr_seed is not None
+                if msg != jr_seed[r].tobytes():
+                    accept[r] = False  # joint-rand confirmation failed
+            elif msg != b"":
+                accept[r] = False
+        return accept
+
+    # -- aggregation -----------------------------------------------
+
+    def agg_share(self, agg_param, accept: np.ndarray) -> bytes:
+        import jax.numpy as jnp
+
+        agg = self.bm.aggregate(jnp.asarray(self._prep.out_share),
+                                jnp.asarray(accept))
+        return np.asarray(
+            self.bm.spec.plain_to_le_bytes(agg)).tobytes()
+
+
+# -- the party process main loop -------------------------------------
+
+def party_main(argv: list[str]) -> None:
+    # The ambient sitecustomize force-overrides jax's platform config
+    # to the remote TPU backend; make the caller's JAX_PLATFORMS
+    # authoritative again (the test fabric runs parties on CPU, and a
+    # down TPU tunnel must not be able to hang a CPU party).
+    import os
+
+    import jax
+
+    requested = os.environ.get("JAX_PLATFORMS", "").strip()
+    if requested and "axon" not in requested.split(","):
+        jax.config.update("jax_platforms", requested)
+    # Share the persistent compile cache with the parent fabric.
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                                     "/tmp/mastic_tpu_jax_cache"))
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+    debug = os.environ.get("MASTIC_PARTY_DEBUG") == "1"
+
+    cfg = json.loads(argv[0])
+    agg_id = cfg["agg_id"]
+
+    def trace(what: str) -> None:
+        if debug:
+            print(f"[party {agg_id}] {what}", file=sys.stderr,
+                  flush=True)
+
+    mastic = instantiate(cfg["mastic"])
+    party = AggregatorParty(mastic, agg_id,
+                            bytes.fromhex(cfg["verify_key"]),
+                            bytes.fromhex(cfg["ctx"]))
+    trace("engine up, connecting")
+
+    coll_sock = socket.create_connection(("127.0.0.1",
+                                          cfg["collector_port"]))
+    coll = _channel(coll_sock)
+    wire.send_msg(coll, bytes([agg_id]))
+
+    peer = None
+    if agg_id == 0:
+        lst = socket.create_server(("127.0.0.1", 0))
+        wire.send_msg(coll, lst.getsockname()[1].to_bytes(2, "little"))
+        trace("listening for helper")
+        (peer_sock, _) = lst.accept()
+        peer = _channel(peer_sock)
+    else:
+        port_msg = wire.recv_msg(coll)
+        assert port_msg is not None
+        peer_sock = socket.create_connection(
+            ("127.0.0.1", int.from_bytes(port_msg, "little")))
+        peer = _channel(peer_sock)
+    trace("peer channel up")
+
+    while True:
+        msg = wire.recv_msg(coll)
+        if msg is None or msg[:1] == b"\x03":
+            trace("shutdown")
+            break
+        if msg[:1] == b"\x01":  # upload
+            body = msg[1:]
+            (num,) = np.frombuffer(body[:4], np.uint32)
+            rest = body[4:]
+            blobs = []
+            for _ in range(int(num)):
+                (blob, rest) = wire.unframe(rest)
+                blobs.append(blob)
+            party.load_reports(blobs)
+            trace(f"loaded {num} reports")
+            wire.send_msg(coll, b"ok")
+        elif msg[:1] == b"\x02":  # one aggregation round
+            agg_param = mastic.decode_agg_param(msg[1:])
+            trace(f"round level={agg_param[0]} compiling prep")
+            blob = party.prep_blob(agg_param)
+            trace("prep done, exchanging")
+            if agg_id == 1:
+                wire.send_msg(peer, blob)
+                resolution = wire.recv_msg(peer)
+                assert resolution is not None
+                accept = party.confirm(agg_param, resolution)
+                wire.send_msg(coll, party.agg_share(agg_param, accept))
+            else:
+                peer_blob = wire.recv_msg(peer)
+                assert peer_blob is not None
+                (accept, resolution) = party.resolve(agg_param,
+                                                     peer_blob)
+                wire.send_msg(peer, resolution)
+                bitmap = np.packbits(accept,
+                                     bitorder="little").tobytes()
+                wire.send_msg(coll, bitmap
+                              + party.agg_share(agg_param, accept))
+            trace("round done")
+
+
+# -- collector side --------------------------------------------------
+
+class ProcessCollector:
+    """Spawns the two aggregator processes and drives rounds against
+    them; the in-process analog is drivers/heavy_hitters.run_round."""
+
+    def __init__(self, mastic: Mastic, mastic_spec: dict, ctx: bytes,
+                 verify_key: bytes):
+        self.m = mastic
+        self.server = socket.create_server(("127.0.0.1", 0))
+        port = self.server.getsockname()[1]
+        env_cfg = {"mastic": mastic_spec, "ctx": ctx.hex(),
+                   "verify_key": verify_key.hex(),
+                   "collector_port": port}
+        self.procs = [
+            subprocess.Popen(
+                [sys.executable, "-m", "mastic_tpu.drivers.parties",
+                 json.dumps({**env_cfg, "agg_id": agg_id})],
+                cwd=_repo_root(), stdout=sys.stderr, stderr=sys.stderr)
+            for agg_id in range(2)
+        ]
+        chans = {}
+        for _ in range(2):
+            (sock, _addr) = self.server.accept()
+            chan = _channel(sock)
+            hello = wire.recv_msg(chan)
+            assert hello is not None
+            chans[hello[0]] = chan
+        (self.leader, self.helper) = (chans[0], chans[1])
+        leader_port = wire.recv_msg(self.leader)
+        assert leader_port is not None
+        wire.send_msg(self.helper, leader_port)
+
+    def upload(self, reports: list) -> None:
+        """reports: [(nonce, public_share, input_shares)] with BOTH
+        input shares (the collector here doubles as the upload relay —
+        clients talk to aggregators directly in a real deployment)."""
+        self.num_reports = len(reports)
+        for (agg_id, chan) in ((0, self.leader), (1, self.helper)):
+            blobs = [
+                wire.encode_report(self.m, agg_id, nonce, ps,
+                                   shares[agg_id])
+                for (nonce, ps, shares) in reports
+            ]
+            body = np.uint32(len(blobs)).tobytes() \
+                + b"".join(wire.frame(b) for b in blobs)
+            wire.send_msg(chan, b"\x01" + body)
+        for chan in (self.leader, self.helper):
+            assert wire.recv_msg(chan) == b"ok"
+
+    def round(self, agg_param) -> tuple:
+        """Run one aggregation round; returns (agg_result, accept)."""
+        encoded = b"\x02" + self.m.encode_agg_param(agg_param)
+        wire.send_msg(self.leader, encoded)
+        wire.send_msg(self.helper, encoded)
+        leader_msg = wire.recv_msg(self.leader)
+        helper_msg = wire.recv_msg(self.helper)
+        assert leader_msg is not None and helper_msg is not None
+        # leader payload: accept bitmap + agg share
+        share_size = wire.agg_share_size(self.m, agg_param)
+        nbytes = len(leader_msg) - share_size
+        accept = np.unpackbits(
+            np.frombuffer(leader_msg[:nbytes], np.uint8),
+            bitorder="little")[:self.num_reports].astype(bool)
+        agg0 = wire.decode_agg_share(self.m, agg_param,
+                                     leader_msg[nbytes:])
+        agg1 = wire.decode_agg_share(self.m, agg_param, helper_msg)
+        num = int(accept.sum())
+        result = self.m.unshard(agg_param, [agg0, agg1], num)
+        return (result, accept, (leader_msg[nbytes:], helper_msg))
+
+    def close(self) -> None:
+        for chan in (self.leader, self.helper):
+            try:
+                wire.send_msg(chan, b"\x03")
+            except (BrokenPipeError, OSError):
+                pass
+        for proc in self.procs:
+            proc.wait(timeout=60)
+        self.server.close()
+
+
+def _repo_root() -> str:
+    import pathlib
+    return str(pathlib.Path(__file__).resolve().parents[2])
+
+
+if __name__ == "__main__":
+    party_main(sys.argv[1:])

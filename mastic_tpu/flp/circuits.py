@@ -28,25 +28,27 @@ class Count(Valid[int, int, F]):
         self.GADGETS: list[Gadget[F]] = [Mul()]
         self.GADGET_CALLS = [1]
 
-    def eval(self, meas, joint_rand, num_shares):
+    def eval(self, meas: list[F], joint_rand: list[F],
+             num_shares: int) -> list[F]:
         self.check_valid_eval(meas, joint_rand)
         squared = self.GADGETS[0].eval(self.field, [meas[0], meas[0]])
         return [squared - meas[0]]
 
-    def encode(self, measurement):
+    def encode(self, measurement: int) -> list[F]:
         if measurement not in range(2):
             raise ValueError("measurement out of range")
         return [self.field(measurement)]
 
-    def truncate(self, meas):
+    def truncate(self, meas: list[F]) -> list[F]:
         if len(meas) != 1:
             raise ValueError("incorrect measurement length")
         return meas
 
-    def decode(self, output, _num_measurements):
+    def decode(self, output: list[F],
+               _num_measurements: int) -> int:
         return output[0].int()
 
-    def test_vec_set_type_param(self, test_vec):
+    def test_vec_set_type_param(self, test_vec: dict) -> list[str]:
         return []
 
 
@@ -69,7 +71,8 @@ class Sum(Valid[int, int, F]):
         self.GADGETS: list[Gadget[F]] = [PolyEval([0, -1, 1])]
         self.GADGET_CALLS = [2 * self.bits]
 
-    def eval(self, meas, joint_rand, num_shares):
+    def eval(self, meas: list[F], joint_rand: list[F],
+             num_shares: int) -> list[F]:
         self.check_valid_eval(meas, joint_rand)
         shares_inv = self.field(num_shares).inv()
         out = []
@@ -81,20 +84,21 @@ class Sum(Valid[int, int, F]):
         out.append(range_check)
         return out
 
-    def encode(self, measurement):
+    def encode(self, measurement: int) -> list[F]:
         if measurement not in range(self.max_measurement + 1):
             raise ValueError("measurement out of range")
         return self.field.encode_into_bit_vector(measurement, self.bits) + \
             self.field.encode_into_bit_vector(
                 measurement + self.offset.int(), self.bits)
 
-    def truncate(self, meas):
+    def truncate(self, meas: list[F]) -> list[F]:
         return [self.field.decode_from_bit_vector(meas[:self.bits])]
 
-    def decode(self, output, _num_measurements):
+    def decode(self, output: list[F],
+               _num_measurements: int) -> int:
         return output[0].int()
 
-    def test_vec_set_type_param(self, test_vec):
+    def test_vec_set_type_param(self, test_vec: dict) -> list[str]:
         test_vec["max_measurement"] = self.max_measurement
         return ["max_measurement"]
 
@@ -146,12 +150,13 @@ class SumVec(_ParallelSumRangeChecks[F], Valid[list[int], list[int], F]):
         self.GADGETS: list[Gadget[F]] = [
             ParallelSum(Mul(), chunk_length)]
 
-    def eval(self, meas, joint_rand, num_shares):
+    def eval(self, meas: list[F], joint_rand: list[F],
+             num_shares: int) -> list[F]:
         self.check_valid_eval(meas, joint_rand)
         return [self.parallel_sum_range_checks(
             meas, joint_rand, self.chunk_length, num_shares)]
 
-    def encode(self, measurement):
+    def encode(self, measurement: list) -> list[F]:
         if len(measurement) != self.length:
             raise ValueError("incorrect measurement length")
         encoded = []
@@ -161,17 +166,18 @@ class SumVec(_ParallelSumRangeChecks[F], Valid[list[int], list[int], F]):
             encoded += self.field.encode_into_bit_vector(val, self.bits)
         return encoded
 
-    def truncate(self, meas):
+    def truncate(self, meas: list[F]) -> list[F]:
         return [
             self.field.decode_from_bit_vector(
                 meas[i * self.bits:(i + 1) * self.bits])
             for i in range(self.length)
         ]
 
-    def decode(self, output, _num_measurements):
+    def decode(self, output: list[F],
+               _num_measurements: int) -> list[int]:
         return [x.int() for x in output]
 
-    def test_vec_set_type_param(self, test_vec):
+    def test_vec_set_type_param(self, test_vec: dict) -> list[str]:
         test_vec["length"] = self.length
         test_vec["bits"] = self.bits
         test_vec["chunk_length"] = self.chunk_length
@@ -194,7 +200,8 @@ class Histogram(_ParallelSumRangeChecks[F], Valid[int, list[int], F]):
         self.GADGETS: list[Gadget[F]] = [
             ParallelSum(Mul(), chunk_length)]
 
-    def eval(self, meas, joint_rand, num_shares):
+    def eval(self, meas: list[F], joint_rand: list[F],
+             num_shares: int) -> list[F]:
         self.check_valid_eval(meas, joint_rand)
         range_check = self.parallel_sum_range_checks(
             meas, joint_rand, self.chunk_length, num_shares)
@@ -204,20 +211,21 @@ class Histogram(_ParallelSumRangeChecks[F], Valid[int, list[int], F]):
             sum_check += b
         return [range_check, sum_check]
 
-    def encode(self, measurement):
+    def encode(self, measurement: int) -> list[F]:
         if measurement not in range(self.length):
             raise ValueError("measurement out of range")
         encoded = self.field.zeros(self.length)
         encoded[measurement] = self.field(1)
         return encoded
 
-    def truncate(self, meas):
+    def truncate(self, meas: list[F]) -> list[F]:
         return meas
 
-    def decode(self, output, _num_measurements):
+    def decode(self, output: list[F],
+               _num_measurements: int) -> list[int]:
         return [x.int() for x in output]
 
-    def test_vec_set_type_param(self, test_vec):
+    def test_vec_set_type_param(self, test_vec: dict) -> list[str]:
         test_vec["length"] = self.length
         test_vec["chunk_length"] = self.chunk_length
         return ["length", "chunk_length"]
@@ -248,7 +256,8 @@ class MultihotCountVec(_ParallelSumRangeChecks[F],
         self.GADGETS: list[Gadget[F]] = [
             ParallelSum(Mul(), chunk_length)]
 
-    def eval(self, meas, joint_rand, num_shares):
+    def eval(self, meas: list[F], joint_rand: list[F],
+             num_shares: int) -> list[F]:
         self.check_valid_eval(meas, joint_rand)
         range_check = self.parallel_sum_range_checks(
             meas, joint_rand, self.chunk_length, num_shares)
@@ -262,7 +271,7 @@ class MultihotCountVec(_ParallelSumRangeChecks[F],
         weight_check = self.offset * shares_inv + weight - weight_reported
         return [range_check, weight_check]
 
-    def encode(self, measurement):
+    def encode(self, measurement: list) -> list[F]:
         if len(measurement) != self.length:
             raise ValueError("incorrect measurement length")
         count_vec = [self.field(int(x)) for x in measurement]
@@ -273,13 +282,14 @@ class MultihotCountVec(_ParallelSumRangeChecks[F],
             weight + self.offset.int(), self.bits_for_weight)
         return count_vec + encoded_weight
 
-    def truncate(self, meas):
+    def truncate(self, meas: list[F]) -> list[F]:
         return meas[:self.length]
 
-    def decode(self, output, _num_measurements):
+    def decode(self, output: list[F],
+               _num_measurements: int) -> list[int]:
         return [x.int() for x in output]
 
-    def test_vec_set_type_param(self, test_vec):
+    def test_vec_set_type_param(self, test_vec: dict) -> list[str]:
         test_vec["length"] = self.length
         test_vec["max_weight"] = self.max_weight
         test_vec["chunk_length"] = self.chunk_length

@@ -34,7 +34,7 @@ from ..common import next_power_of_2
 from ..ops.field_jax import FieldSpec, field_sum, spec_for
 from ..ops.ntt_jax import ntt_plan, poly_eval_mont, pow_static, power_chain
 from .circuits import Count, Histogram, MultihotCountVec, Sum, SumVec
-from .flp import FlpBBCGGI19, Mul, ParallelSum, PolyEval
+from .flp import FlpBBCGGI19, ParallelSum
 
 
 class BatchedFlp:

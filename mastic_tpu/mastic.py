@@ -97,7 +97,9 @@ class Mastic(
 
     # -- client (reference mastic.py:91-185) -----------------------
 
-    def shard(self, ctx, measurement, nonce, rand):
+    def shard(self, ctx: bytes, measurement: "tuple[Path, W]",
+          nonce: bytes, rand: bytes
+          ) -> tuple[list[CorrectionWord], list[MasticInputShare]]:
         """Produce the public share (VIDPF correction words) and the
         two input shares.  One code path serves both FLP families: for
         joint-rand circuits the client additionally derives both
@@ -151,7 +153,8 @@ class Mastic(
 
     # -- aggregation-parameter policy (reference mastic.py:187-203) -
 
-    def is_valid(self, agg_param, previous_agg_params):
+    def is_valid(self, agg_param: MasticAggParam,
+             previous_agg_params: list[MasticAggParam]) -> bool:
         (level, _prefixes, do_weight_check) = agg_param
 
         # The weight check happens exactly once, on the first round.
@@ -168,8 +171,11 @@ class Mastic(
 
     # -- aggregator (reference mastic.py:205-318) ------------------
 
-    def prep_init(self, verify_key, ctx, agg_id, agg_param, nonce,
-                  correction_words, input_share):
+    def prep_init(self, verify_key: bytes, ctx: bytes, agg_id: int,
+                  agg_param: MasticAggParam, nonce: bytes,
+                  correction_words: list[CorrectionWord],
+                  input_share: MasticInputShare
+                  ) -> tuple[MasticPrepState, MasticPrepShare]:
         (level, prefixes, do_weight_check) = agg_param
         (key, proof_share, seed, peer_joint_rand_part) = \
             self.expand_input_share(ctx, agg_id, input_share)
@@ -273,7 +279,10 @@ class Mastic(
                 onehot_check_binder += node.proof
         return (payload_check_binder, onehot_check_binder)
 
-    def prep_shares_to_prep(self, ctx, agg_param, prep_shares):
+    def prep_shares_to_prep(self, ctx: bytes,
+                        agg_param: MasticAggParam,
+                        prep_shares: list[MasticPrepShare]
+                        ) -> MasticPrepMessage:
         (_level, _prefixes, do_weight_check) = agg_param
 
         if len(prep_shares) != 2:
@@ -304,7 +313,8 @@ class Mastic(
         return self.joint_rand_seed(ctx, [joint_rand_part_0,
                                           joint_rand_part_1])
 
-    def prep_next(self, _ctx, prep_state, prep_msg):
+    def prep_next(self, _ctx: bytes, prep_state: MasticPrepState,
+              prep_msg: MasticPrepMessage) -> list:
         (truncated_out_share, joint_rand_seed) = prep_state
         if joint_rand_seed is not None:
             if prep_msg is None:
@@ -315,20 +325,23 @@ class Mastic(
 
     # -- aggregation & collection (reference mastic.py:379-411) ----
 
-    def agg_init(self, agg_param):
+    def agg_init(self, agg_param: MasticAggParam) -> list:
         (_level, prefixes, _do_weight_check) = agg_param
         return self.field.zeros(len(prefixes) * (1 + self.flp.OUTPUT_LEN))
 
-    def agg_update(self, agg_param, agg_share, out_share):
+    def agg_update(self, agg_param: MasticAggParam, agg_share: list,
+               out_share: list) -> list:
         return vec_add(agg_share, out_share)
 
-    def merge(self, agg_param, agg_shares):
+    def merge(self, agg_param: MasticAggParam,
+          agg_shares: list) -> list:
         agg = self.agg_init(agg_param)
         for agg_share in agg_shares:
             agg = vec_add(agg, agg_share)
         return agg
 
-    def unshard(self, agg_param, agg_shares, _num_measurements):
+    def unshard(self, agg_param: MasticAggParam, agg_shares: list,
+            _num_measurements: int) -> list:
         agg = self.merge(agg_param, agg_shares)
         agg_result = []
         while len(agg) > 0:
@@ -370,7 +383,10 @@ class Mastic(
         do_weight_check = bool(encoded[off])
         return (level, tuple(prefixes), do_weight_check)
 
-    def expand_input_share(self, ctx, agg_id, input_share):
+    def expand_input_share(
+            self, ctx: bytes, agg_id: int,
+            input_share: MasticInputShare
+    ) -> tuple[bytes, list, Optional[bytes], Optional[bytes]]:
         if agg_id == 0:
             (key, proof_share, seed, peer_joint_rand_part) = input_share
             assert proof_share is not None

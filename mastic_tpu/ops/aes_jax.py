@@ -85,14 +85,17 @@ def _gf_inv_planes(x):
     return _gf_mul_planes(x252, x2)
 
 
-def _sbox_planes(x):
+def _sbox_planes(x, one=1):
+    """The affine-constant term `one` is 1 for 0/1-valued byte planes
+    and all-ones for bit-packed uint32 planes (the circuit itself is
+    representation-agnostic: only &, ^ between planes)."""
     inv = _gf_inv_planes(x)
     out = []
     for i in range(8):
         bit = inv[i] ^ inv[(i + 4) % 8] ^ inv[(i + 5) % 8] \
             ^ inv[(i + 6) % 8] ^ inv[(i + 7) % 8]
         if (0x63 >> i) & 1:
-            bit = bit ^ 1
+            bit = bit ^ one
         out.append(bit)
     return out
 
@@ -176,3 +179,108 @@ def aes128_encrypt(round_keys: jax.Array, blocks: jax.Array) -> jax.Array:
 
     (state, _) = jax.lax.scan(body, state, mid)
     return _sub_shift(state) ^ round_keys[..., 10, :]
+
+
+# -- batch-bitsliced path ---------------------------------------------
+#
+# The byte path above stores one 0/1 plane value per array element, so
+# every VPU lane carries a single data bit (uint8 elementwise ops run
+# in 32-bit lanes on TPU).  For large batches the state is instead
+# bit-transposed along the batch axis: bit j of the uint32 word at
+# packed index w is batch element 32*w + j, and each of the 128
+# (byte, bit) state positions becomes a dense word vector.  The
+# boolean circuit is unchanged — its arrays are 32x smaller, which is
+# the difference between the VPU spending lanes on padding and
+# spending them on data.  Constant-time discipline is preserved (same
+# gates, no lookups).
+
+_U32 = jnp.uint32
+# numpy scalar on purpose: a jnp constant at module scope would
+# initialize the JAX backend at import time (see _RC_LO note in
+# ops/keccak_jax.py) — and with the remote-TPU tunnel down that hangs
+# every fresh process that merely imports this module.
+_ONES32 = np.uint32(0xFFFFFFFF)
+_SHIFT_ROWS_ARR = np.asarray(_SHIFT_ROWS)
+
+
+def bitslice_pack(x: jax.Array) -> jax.Array:
+    """uint8 (M, ..., K) with M % 32 == 0 -> planes (8, K, ..., M//32)
+    uint32, where bit j of word w is element 32*w + j of the leading
+    axis."""
+    m = x.shape[0]
+    assert m % 32 == 0
+    rest = x.shape[1:-1]
+    xr = x.reshape((m // 32, 32) + rest + x.shape[-1:]).astype(_U32)
+    shifts = jnp.arange(32, dtype=_U32).reshape(
+        (1, 32) + (1,) * (len(rest) + 1))
+    planes = []
+    for b in range(8):
+        bits = (xr >> b) & _U32(1)
+        planes.append(jnp.sum(bits << shifts, axis=1, dtype=_U32))
+    p = jnp.stack(planes)          # (8, W, ..., K)
+    p = jnp.moveaxis(p, -1, 1)     # (8, K, W, ...)
+    return jnp.moveaxis(p, 2, -1)  # (8, K, ..., W)
+
+
+def bitslice_unpack(planes: jax.Array) -> jax.Array:
+    """Inverse of bitslice_pack: (8, K, ..., W) -> (32*W, ..., K)."""
+    p = jnp.moveaxis(planes, -1, 2)  # (8, K, W, ...)
+    p = jnp.moveaxis(p, 1, -1)       # (8, W, ..., K)
+    shifts = jnp.arange(32, dtype=_U32).reshape(
+        (1, 32) + (1,) * (p.ndim - 2))
+    acc = None
+    for b in range(8):
+        bits = ((p[b][:, None] >> shifts) & _U32(1)) << b
+        acc = bits if acc is None else acc | bits
+    out = acc.astype(_U8)            # (W, 32, ..., K)
+    return out.reshape((-1,) + out.shape[2:])
+
+
+def bitslice_keys(round_keys: jax.Array) -> jax.Array:
+    """Key schedules (R, 11, 16) uint8 -> key planes (11, 8, 16, R//32)
+    uint32 (R % 32 == 0)."""
+    return jnp.moveaxis(bitslice_pack(round_keys), 2, 0)
+
+
+def _xtime_planes(v: jax.Array) -> jax.Array:
+    """xtime on a (8, ...) plane stack: shift planes up one, fold the
+    top plane into the 0x1B taps (bits 1, 3, 4; bit 0 is the rolled-in
+    top plane itself)."""
+    out = jnp.roll(v, 1, axis=0)
+    hi = v[7]
+    out = out.at[1].set(out[1] ^ hi)
+    out = out.at[3].set(out[3] ^ hi)
+    return out.at[4].set(out[4] ^ hi)
+
+
+def _mix_columns_planes(s: jax.Array) -> jax.Array:
+    c = s.reshape((8, 4, 4) + s.shape[2:])  # (planes, col, row, ...)
+    rot1 = jnp.roll(c, -1, axis=2)
+    mixed = _xtime_planes(c) ^ _xtime_planes(rot1) ^ rot1 \
+        ^ jnp.roll(c, -2, axis=2) ^ jnp.roll(c, -3, axis=2)
+    return mixed.reshape(s.shape)
+
+
+def _sub_shift_planes(s: jax.Array) -> jax.Array:
+    sb = jnp.stack(_sbox_planes([s[b] for b in range(8)], one=_ONES32))
+    return sb[:, _SHIFT_ROWS_ARR]
+
+
+def aes128_encrypt_bitsliced(key_planes: jax.Array,
+                             planes: jax.Array) -> jax.Array:
+    """Bitsliced ECB encrypt.
+
+    key_planes: (11, 8, 16, W) from bitslice_keys — one schedule per
+    packed batch element.  planes: (8, 16, ..., W) state planes whose
+    middle dims broadcast against the keys (many blocks per batch
+    element, e.g. every tree node of a report)."""
+    extra = planes.ndim - 3
+    kp = key_planes.reshape(
+        (11, 8, 16) + (1,) * extra + key_planes.shape[-1:])
+
+    def body(state, rk):
+        return (_mix_columns_planes(_sub_shift_planes(state)) ^ rk, None)
+
+    state = planes ^ kp[0]
+    (state, _) = jax.lax.scan(body, state, kp[1:10])
+    return _sub_shift_planes(state) ^ kp[10]

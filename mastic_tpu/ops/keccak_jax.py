@@ -15,6 +15,8 @@ protocol parameters (reference poc/vidpf.py:366-380, poc/mastic.py:
 452-510).
 """
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -23,6 +25,12 @@ from ..keccak import RHO_OFFSETS, ROUND_CONSTANTS
 
 RATE = 168  # TurboSHAKE128 rate in bytes (21 lanes)
 _U32 = jnp.uint32
+
+# Round-loop unroll factor for the permutation scan (see keccak_p1600).
+# 1 keeps compiles cheap (the CPU test suite compiles every program
+# once); bench.py raises it on the real chip where fusing rounds
+# avoids HBM round-trips of the scan carry.
+UNROLL = int(os.environ.get("MASTIC_KECCAK_UNROLL", "1"))
 
 
 def _rotl64(lo: jax.Array, hi: jax.Array, n: int):
@@ -41,10 +49,13 @@ def _rotl64(lo: jax.Array, hi: jax.Array, n: int):
     return (new_lo, new_hi)
 
 
-def _keccak_round(lo: jax.Array, hi: jax.Array, rc_lo: jax.Array,
-                  rc_hi: jax.Array):
-    """One Keccak-p round on (..., 25) lane halves."""
-    a = [(lo[..., i], hi[..., i]) for i in range(25)]
+def _keccak_round(a: list, rc_lo: jax.Array, rc_hi: jax.Array) -> list:
+    """One Keccak-p round on a list of 25 (lo, hi) lane-half pairs.
+
+    The state stays a flat list of batch-dense arrays end to end: a
+    (..., 25) layout would make every lane access a stride-25 slice
+    and every round a re-interleave, which XLA lowers to relayout
+    copies that dominate the permutation cost on TPU (measured ~4x)."""
     # theta
     c = []
     for x in range(5):
@@ -75,8 +86,7 @@ def _keccak_round(lo: jax.Array, hi: jax.Array, rc_lo: jax.Array,
     ]
     # iota
     a[0] = (a[0][0] ^ rc_lo, a[0][1] ^ rc_hi)
-    return (jnp.stack([x[0] for x in a], axis=-1),
-            jnp.stack([x[1] for x in a], axis=-1))
+    return a
 
 
 # Kept as numpy at module scope so importing this module never
@@ -98,15 +108,24 @@ def keccak_p1600(lo: jax.Array, hi: jax.Array, num_rounds: int = 12):
     """
 
     def body(carry, rcs):
-        (lo, hi) = carry
         (rc_lo, rc_hi) = rcs
-        return (_keccak_round(lo, hi, rc_lo, rc_hi), None)
+        a = [(carry[i], carry[25 + i]) for i in range(25)]
+        a = _keccak_round(a, rc_lo, rc_hi)
+        return ([x[0] for x in a] + [x[1] for x in a], None)
 
     start = 24 - num_rounds
-    ((lo, hi), _) = jax.lax.scan(
-        body, (lo, hi),
-        (jnp.asarray(_RC_LO[start:]), jnp.asarray(_RC_HI[start:])))
-    return (lo, hi)
+    # De-interleave once at entry, re-interleave once at exit: the
+    # scan carry is a flat list of 50 batch-dense uint32 arrays.
+    # UNROLL trades compile time for fusion across rounds (the scan
+    # carry otherwise round-trips 50 arrays through HBM every round).
+    lanes = [lo[..., i] for i in range(25)] + \
+        [hi[..., i] for i in range(25)]
+    (lanes, _) = jax.lax.scan(
+        body, lanes,
+        (jnp.asarray(_RC_LO[start:]), jnp.asarray(_RC_HI[start:])),
+        unroll=UNROLL)
+    return (jnp.stack(lanes[:25], axis=-1),
+            jnp.stack(lanes[25:], axis=-1))
 
 
 def bytes_to_lanes(data: jax.Array):

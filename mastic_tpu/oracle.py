@@ -3,11 +3,13 @@ crypto.  Differential-testing oracle for the drivers (the reference
 ships the same kind of model at talks/func.py).
 """
 
-from typing import Sequence
+from typing import Any, Callable, Sequence
 
 
-def prefix_weights(measurements: Sequence[tuple], prefixes: Sequence[tuple],
-                   zero, add):
+def prefix_weights(measurements: Sequence[tuple],
+                   prefixes: Sequence[tuple],
+                   zero: Callable[[], Any],
+                   add: Callable[[Any, Any], Any]) -> dict:
     """Total weight per candidate prefix: sum of beta over measurements
     whose alpha has that prefix.  `zero`/`add` abstract the weight
     monoid (ints, vectors, ...)."""

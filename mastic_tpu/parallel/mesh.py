@@ -19,7 +19,7 @@ across levels/rounds.
 from typing import Optional
 
 import jax
-import jax.numpy as jnp
+
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 

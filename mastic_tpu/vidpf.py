@@ -22,7 +22,7 @@ from typing import Generic, Sequence, TypeAlias
 from .common import pack_bits, pack_bits_le, to_le_bytes, unpack_bits_le, \
     vec_add, vec_neg, vec_sub, xor
 from .dst import USAGE_CONVERT, USAGE_EXTEND, USAGE_NODE_PROOF, dst
-from .field import F, NttField
+from .field import F
 from .xof import XofFixedKeyAes128, XofTurboShake128
 
 PROOF_SIZE: int = 32

@@ -1,0 +1,178 @@
+"""Inter-party wire codec: byte encodings and decoders for every
+channel of the protocol.
+
+The encoders are shared with the conformance harness
+(mastic_tpu.testvec_codec — the layouts are pinned by the reference's
+test vectors, /root/reference/test_vec/mastic/*.json; reference wire
+types at /root/reference/poc/mastic.py:31-49, encoders :512-559).
+This module adds the decoders (the reference PoC never parses its own
+encodings — parties pass Python objects in-process) plus the framing
+used by the process-separated parties (drivers/parties.py).
+
+All message lengths are static given (instantiation, agg_id,
+agg_param) — the size formulas of SURVEY.md §2.4, asserted by
+tests/test_wire.py and drivers/communication.py.
+"""
+
+import struct
+from typing import IO, Optional
+
+from .mastic import (Mastic, MasticAggParam, MasticInputShare,
+                     MasticPrepMessage, MasticPrepShare)
+from .vidpf import CorrectionWord
+from .xof import XofTurboShake128
+
+SEED_SIZE = XofTurboShake128.SEED_SIZE
+KEY_SIZE = 16
+PROOF_SIZE = 32
+
+
+# -- sizes (SURVEY.md §2.4, verified byte-exact vs test_vec/) --------
+
+def input_share_size(mastic: Mastic, agg_id: int) -> int:
+    use_jr = mastic.flp.JOINT_RAND_LEN > 0
+    if agg_id == 0:
+        size = KEY_SIZE + mastic.flp.PROOF_LEN * mastic.field.ENCODED_SIZE
+        if use_jr:
+            size += 2 * SEED_SIZE
+    else:
+        size = KEY_SIZE + SEED_SIZE
+        if use_jr:
+            size += SEED_SIZE
+    return size
+
+
+def prep_share_size(mastic: Mastic, agg_param: MasticAggParam) -> int:
+    (_level, _prefixes, do_weight_check) = agg_param
+    size = PROOF_SIZE
+    if do_weight_check:
+        if mastic.flp.JOINT_RAND_LEN > 0:
+            size += SEED_SIZE
+        size += mastic.flp.VERIFIER_LEN * mastic.field.ENCODED_SIZE
+    return size
+
+
+def agg_share_size(mastic: Mastic, agg_param: MasticAggParam) -> int:
+    (_level, prefixes, _wc) = agg_param
+    return len(prefixes) * (1 + mastic.flp.OUTPUT_LEN) \
+        * mastic.field.ENCODED_SIZE
+
+
+def public_share_size(mastic: Mastic) -> int:
+    """ceil(2*BITS/8) packed ctrl bits + per-level seed, payload CW
+    and proof CW (SURVEY.md §2.4; encoder mastic_tpu/vidpf.py:335)."""
+    bits = mastic.vidpf.BITS
+    return (2 * bits + 7) // 8 + bits * (
+        KEY_SIZE + PROOF_SIZE
+        + mastic.vidpf.VALUE_LEN * mastic.field.ENCODED_SIZE)
+
+
+# -- decoders (inverses of testvec_codec's encoders) -----------------
+
+def decode_input_share(mastic: Mastic, agg_id: int,
+                       encoded: bytes) -> MasticInputShare:
+    if len(encoded) != input_share_size(mastic, agg_id):
+        raise ValueError("input share has incorrect length")
+    use_jr = mastic.flp.JOINT_RAND_LEN > 0
+    (key, rest) = (encoded[:KEY_SIZE], encoded[KEY_SIZE:])
+    proof_share = None
+    seed = None
+    if agg_id == 0:
+        plen = mastic.flp.PROOF_LEN * mastic.field.ENCODED_SIZE
+        proof_share = mastic.field.decode_vec(rest[:plen])
+        rest = rest[plen:]
+        if use_jr:
+            (seed, rest) = (rest[:SEED_SIZE], rest[SEED_SIZE:])
+    else:
+        (seed, rest) = (rest[:SEED_SIZE], rest[SEED_SIZE:])
+    peer_part = rest[:SEED_SIZE] if use_jr else None
+    return (key, proof_share, seed, peer_part)
+
+
+def decode_public_share(mastic: Mastic,
+                        encoded: bytes) -> list[CorrectionWord]:
+    return mastic.vidpf.decode_public_share(encoded)
+
+
+def decode_prep_share(mastic: Mastic, agg_param: MasticAggParam,
+                      encoded: bytes) -> MasticPrepShare:
+    if len(encoded) != prep_share_size(mastic, agg_param):
+        raise ValueError("prep share has incorrect length")
+    (_level, _prefixes, do_weight_check) = agg_param
+    (eval_proof, rest) = (encoded[:PROOF_SIZE], encoded[PROOF_SIZE:])
+    verifier = None
+    jr_part = None
+    if do_weight_check:
+        if mastic.flp.JOINT_RAND_LEN > 0:
+            (jr_part, rest) = (rest[:SEED_SIZE], rest[SEED_SIZE:])
+        verifier = mastic.field.decode_vec(rest)
+    return (eval_proof, verifier, jr_part)
+
+
+def decode_prep_msg(mastic: Mastic, agg_param: MasticAggParam,
+                    encoded: bytes) -> MasticPrepMessage:
+    (_level, _prefixes, do_weight_check) = agg_param
+    if do_weight_check and mastic.flp.JOINT_RAND_LEN > 0:
+        if len(encoded) != SEED_SIZE:
+            raise ValueError("prep message has incorrect length")
+        return encoded
+    if encoded != b"":
+        raise ValueError("unexpected prep message payload")
+    return None
+
+
+def decode_agg_share(mastic: Mastic, agg_param: MasticAggParam,
+                     encoded: bytes) -> list:
+    if len(encoded) != agg_share_size(mastic, agg_param):
+        raise ValueError("aggregate share has incorrect length")
+    return mastic.field.decode_vec(encoded)
+
+
+# -- report upload framing -------------------------------------------
+
+def encode_report(mastic: Mastic, agg_id: int, nonce: bytes,
+                  public_share: list[CorrectionWord],
+                  input_share: MasticInputShare) -> bytes:
+    """One aggregator's view of an upload: nonce || public share ||
+    that party's input share (all fixed-size for the instantiation)."""
+    from .testvec_codec import encode_input_share
+    return nonce + mastic.vidpf.encode_public_share(public_share) \
+        + encode_input_share(mastic, input_share)
+
+
+def decode_report(mastic: Mastic, agg_id: int, encoded: bytes) -> tuple:
+    nonce = encoded[:mastic.NONCE_SIZE]
+    rest = encoded[mastic.NONCE_SIZE:]
+    ps_size = public_share_size(mastic)
+    public_share = mastic.vidpf.decode_public_share(rest[:ps_size])
+    input_share = decode_input_share(mastic, agg_id, rest[ps_size:])
+    return (nonce, public_share, input_share)
+
+
+# -- stream framing for the party channels ---------------------------
+
+def send_msg(stream: IO[bytes], payload: bytes) -> None:
+    stream.write(struct.pack("<I", len(payload)) + payload)
+    stream.flush()
+
+
+def recv_msg(stream: IO[bytes]) -> Optional[bytes]:
+    header = stream.read(4)
+    if len(header) < 4:
+        return None
+    (length,) = struct.unpack("<I", header)
+    payload = stream.read(length)
+    if len(payload) < length:
+        raise EOFError("truncated message")
+    return payload
+
+
+def frame(payload: bytes) -> bytes:
+    """Length-prefix a message for embedding in a larger blob."""
+    return struct.pack("<I", len(payload)) + payload
+
+
+def unframe(buf: bytes) -> tuple[bytes, bytes]:
+    """Pop one length-prefixed message: -> (payload, rest)."""
+    (length,) = struct.unpack("<I", buf[:4])
+    return (buf[4:4 + length], buf[4 + length:])

@@ -14,9 +14,10 @@ onward while still accepting below it.
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from mastic_tpu import MasticCount, MasticHistogram
 from mastic_tpu.backend.mastic_jax import BatchedMastic
-from mastic_tpu.common import gen_rand
 
 BITS = 5
 CTX = b"adversarial test"

@@ -9,6 +9,8 @@ import numpy as np
 import jax.numpy as jnp
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from mastic_tpu import (MasticCount, MasticHistogram,
                         MasticMultihotCountVec, MasticSum, MasticSumVec)
 from mastic_tpu.backend.mastic_jax import BatchedMastic

@@ -9,6 +9,8 @@ import jax
 import jax.numpy as jnp
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from mastic_tpu.backend import BatchedVidpf, LevelSchedule
 from mastic_tpu.backend.vidpf_jax import pack_path_bits
 from mastic_tpu.common import pack_bits

@@ -7,6 +7,10 @@ cache-across-rounds state the reference names at
 /root/reference/poc/vidpf.py:243-245).
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import numpy as np
 
 from mastic_tpu import MasticCount

@@ -2,7 +2,10 @@
 metrics, and the communication report vs the measured size formulas
 (SURVEY.md §2.4)."""
 
-import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
 
 from mastic_tpu import MasticCount, MasticSum
 from mastic_tpu.drivers import (aggregate_by_attribute,

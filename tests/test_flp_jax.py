@@ -11,6 +11,8 @@ import jax
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from mastic_tpu.field import Field64, Field128
 from mastic_tpu.flp.circuits import (Count, Histogram, MultihotCountVec,
                                      Sum, SumVec)

@@ -7,6 +7,8 @@ import jax
 import jax.numpy as jnp
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from mastic_tpu import MasticCount, MasticSum
 from mastic_tpu.backend.incremental import (IncrementalMastic, RoundPlan,
                                             round_inputs)

@@ -1,0 +1,103 @@
+"""Observability: per-round metrics from the heavy-hitters driver,
+locked against an independent recount of SURVEY.md §3.2's op model
+and the wire size formulas."""
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+
+from mastic_tpu import wire
+from mastic_tpu.backend.incremental import needed_paths
+from mastic_tpu.backend.schedule import LevelSchedule
+from mastic_tpu.common import gen_rand
+from mastic_tpu.drivers.heavy_hitters import (
+    HeavyHittersRun, get_reports_from_measurements)
+from mastic_tpu.mastic import MasticCount
+
+CTX = b"metrics test"
+THRESHOLDS = {"default": 2}
+
+
+def _measurements():
+    return [((bool(v >> 2 & 1), bool(v >> 1 & 1), bool(v & 1)), 1)
+            for v in [0, 0, 0, 5, 5, 3]]
+
+
+def _convert_blocks(m):
+    payload = m.vidpf.VALUE_LEN * m.field.ENCODED_SIZE
+    return 1 + (payload + 15) // 16
+
+
+@pytest.mark.parametrize("incremental", [True, False],
+                         ids=["incremental", "from-root"])
+def test_op_model_and_bytes(incremental) -> None:
+    m = MasticCount(3)
+    reports = get_reports_from_measurements(m, CTX, _measurements())
+    # Tamper one report's VIDPF key: rejected via the eval-proof check.
+    (nonce, ps, shares) = reports[0]
+    (key, proof, seed, part) = shares[0]
+    shares = [(bytes([key[0] ^ 1]) + key[1:], proof, seed, part),
+              shares[1]]
+    reports[0] = (nonce, ps, shares)
+
+    run = HeavyHittersRun(m, CTX, THRESHOLDS, reports,
+                          verify_key=gen_rand(m.VERIFY_KEY_SIZE),
+                          incremental=incremental)
+    while run.step():
+        pass
+    assert len(run.metrics) == len(run.prev_agg_params)
+
+    num = len(reports)
+    for (metrics, agg_param) in zip(run.metrics, run.prev_agg_params):
+        (level, prefixes, do_wc) = agg_param
+        assert metrics.level == level
+        assert metrics.frontier_width == len(prefixes)
+        assert metrics.reports_total == num
+        # Verdict counters partition the batch.
+        assert (metrics.accepted + metrics.rejected_eval_proof
+                + metrics.rejected_weight_check
+                + metrics.rejected_joint_rand
+                + metrics.rejected_fallback) == num
+        assert metrics.xof_fallbacks == 0
+        # The tampered report fails the eval-proof check every round.
+        assert metrics.rejected_eval_proof == 1
+        assert metrics.rejected_weight_check == 0
+
+        # Structural op counts vs an independent recount.
+        if incremental:
+            nodes = len(needed_paths(prefixes, level)[level])
+        else:
+            nodes = LevelSchedule(prefixes, level, 3).total_nodes
+        assert metrics.node_evals == 2 * num * nodes
+        assert metrics.aes_extend_blocks == metrics.node_evals
+        assert metrics.aes_convert_blocks == \
+            metrics.node_evals * _convert_blocks(m)
+        assert metrics.keccak_node_proofs == metrics.node_evals
+
+        # Channel bytes from the conformance-locked size formulas.
+        assert metrics.bytes_prep_shares == \
+            2 * num * wire.prep_share_size(m, agg_param)
+        assert metrics.bytes_agg_shares == \
+            2 * wire.agg_share_size(m, agg_param)
+        assert metrics.bytes_prep_msgs == 0  # Count: no joint rand
+
+    # The incremental engine's total tree work is the from-root
+    # engine's LAST round alone, give or take the depth-0 rows —
+    # O(sum of frontiers) vs O(sum of whole-tree re-walks).
+    if incremental:
+        total = sum(mx.node_evals for mx in run.metrics)
+        frontier_total = sum(
+            2 * num * len(needed_paths(p, lv)[lv])
+            for (lv, p, _wc) in run.prev_agg_params)
+        assert total == frontier_total
+
+
+def test_metrics_as_dict() -> None:
+    from mastic_tpu.metrics import RoundMetrics
+
+    metrics = RoundMetrics(level=0, frontier_width=2, padded_width=4,
+                           reports_total=3)
+    d = metrics.as_dict()
+    assert d["level"] == 0 and d["reports_total"] == 3
+    assert "node_evals" in d and "bytes_prep_shares" in d

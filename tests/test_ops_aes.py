@@ -35,3 +35,54 @@ def test_key_schedule_matches_scalar():
         want = Aes128(bytes(keys[b])).round_keys
         for r in range(11):
             assert bytes(rk[b, r]) == want[r]
+
+
+def test_bitsliced_matches_byte_path():
+    """The batch-bitsliced circuit (32 blocks per uint32 word) against
+    the byte-plane path, including report-axis padding (R % 32 != 0)
+    and the Davies-Meyer construction in fixed_key_blocks."""
+    from mastic_tpu.ops.aes_jax import (aes128_encrypt_bitsliced,
+                                        bitslice_keys, bitslice_pack,
+                                        bitslice_unpack)
+
+    rng = np.random.default_rng(4)
+    keys = rng.integers(0, 256, size=(32, 16), dtype=np.uint8)
+    blocks = rng.integers(0, 256, size=(32, 3, 16), dtype=np.uint8)
+    rk = aes128_key_schedule(keys)
+    want = np.asarray(aes128_encrypt(rk[:, None], blocks))
+    got = np.asarray(bitslice_unpack(aes128_encrypt_bitsliced(
+        bitslice_keys(rk), bitslice_pack(blocks))))
+    assert (got == want).all()
+
+
+def test_bitslice_pack_roundtrip():
+    from mastic_tpu.ops.aes_jax import bitslice_pack, bitslice_unpack
+
+    rng = np.random.default_rng(5)
+    x = rng.integers(0, 256, size=(64, 2, 16), dtype=np.uint8)
+    assert (np.asarray(bitslice_unpack(bitslice_pack(x))) == x).all()
+
+
+def test_fixed_key_blocks_bitslice_switch():
+    """fixed_key_blocks takes the bitsliced path for R >= 32 (with
+    padding when R % 32 != 0) and must agree with the byte path."""
+    import jax.numpy as jnp
+
+    from mastic_tpu.backend import xof_jax
+
+    rng = np.random.default_rng(6)
+    for (r, shape, m) in [(33, (5,), 2), (32, (), 1), (40, (2,), 3)]:
+        keys = jnp.asarray(rng.integers(0, 256, (r, 16), np.uint8))
+        rk = aes128_key_schedule(keys)
+        seeds = jnp.asarray(
+            rng.integers(0, 256, (r,) + shape + (16,), np.uint8))
+        got = np.asarray(xof_jax.fixed_key_blocks(rk, seeds, m))
+        x = seeds[..., None, :] ^ jnp.asarray(xof_jax._block_indices(m))
+        (lo, hi) = (x[..., :8], x[..., 8:])
+        sigma = jnp.concatenate([hi, hi ^ lo], axis=-1)
+        extra = sigma.ndim - rk.ndim + 1
+        rkb = rk.reshape(rk.shape[:-2] + (1,) * extra + rk.shape[-2:])
+        want = np.asarray(
+            (aes128_encrypt(rkb, sigma) ^ sigma).reshape(
+                sigma.shape[:-2] + (m * 16,)))
+        assert (got == want).all(), (r, shape, m)

@@ -4,13 +4,16 @@ The sharded round must produce bit-identical results to the unsharded
 batched backend (collectives must not change the math).
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import numpy as np
 import jax
 import jax.numpy as jnp
 
 from mastic_tpu import MasticCount
 from mastic_tpu.backend.mastic_jax import BatchedMastic
-from mastic_tpu.common import gen_rand
 from mastic_tpu.parallel import (install_grid_sharding, make_mesh,
                                  shard_batch, sharded_gen_fn,
                                  sharded_round_fn)

@@ -21,6 +21,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import mastic_tpu.backend.mastic_jax as mastic_jax
 import mastic_tpu.backend.vidpf_jax as vidpf_jax
 import mastic_tpu.backend.xof_jax as xof_jax

@@ -4,7 +4,6 @@ import pytest
 
 from mastic_tpu import MasticCount, MasticHistogram
 from mastic_tpu.common import gen_rand
-from mastic_tpu.field import Field64
 
 
 def test_public_share_round_trip():

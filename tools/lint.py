@@ -1,0 +1,147 @@
+#!/usr/bin/env python3
+"""Minimal dependency-free lint gate (pyflakes is not in this image).
+
+Checks, over mastic_tpu/, tests/, tools/ and the repo-root scripts:
+
+1. every file parses (syntax);
+2. unused imports (name imported but never referenced);
+3. public functions/methods in the scalar protocol layer carry full
+   type annotations (the local stand-in for the reference's strict
+   mypy gate, /root/reference/.github/workflows/test.yml:36-44 —
+   mypy.ini is shipped for environments that have mypy);
+4. no `print(` in library code (drivers return data; observability is
+   the metrics dict).
+
+Exit status 0 iff clean.  Run via `make lint` / `make ci`.
+"""
+
+import ast
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+# Scalar-layer modules held to the annotation standard (the batched
+# JAX layer's shapes/dtypes are documented in docstrings instead).
+ANNOTATED = [
+    "mastic_tpu/common.py", "mastic_tpu/dst.py", "mastic_tpu/field.py",
+    "mastic_tpu/xof.py", "mastic_tpu/aes.py", "mastic_tpu/keccak.py",
+    "mastic_tpu/vidpf.py", "mastic_tpu/mastic.py", "mastic_tpu/vdaf.py",
+    "mastic_tpu/oracle.py", "mastic_tpu/flp/flp.py",
+    "mastic_tpu/flp/circuits.py", "mastic_tpu/testvec_codec.py",
+]
+
+PRINT_OK = ("tools/", "bench.py", "gen_test_vec.py", "tests/",
+            "__graft_entry__.py", "demo")
+
+
+class ImportTracker(ast.NodeVisitor):
+    def __init__(self):
+        self.imported: dict = {}
+        self.used: set = set()
+
+    def visit_Import(self, node):
+        for alias in node.names:
+            name = (alias.asname or alias.name).split(".")[0]
+            self.imported.setdefault(name, node.lineno)
+
+    def visit_ImportFrom(self, node):
+        for alias in node.names:
+            if alias.name == "*":
+                continue
+            name = alias.asname or alias.name
+            self.imported.setdefault(name, node.lineno)
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, ast.Load):
+            self.used.add(node.id)
+
+    def visit_Attribute(self, node):
+        self.generic_visit(node)
+
+
+def check_file(path: pathlib.Path) -> list:
+    rel = str(path.relative_to(REPO))
+    problems = []
+    try:
+        tree = ast.parse(path.read_text(), filename=rel)
+    except SyntaxError as err:
+        return [f"{rel}:{err.lineno}: syntax error: {err.msg}"]
+
+    tracker = ImportTracker()
+    tracker.visit(tree)
+    # Names used only inside docstring type references don't count;
+    # __all__ re-exports do.
+    exported = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for target in node.targets:
+                if isinstance(target, ast.Name) and target.id == "__all__":
+                    if isinstance(node.value, (ast.List, ast.Tuple)):
+                        for elt in node.value.elts:
+                            if isinstance(elt, ast.Constant):
+                                exported.add(elt.value)
+    if not rel.endswith("__init__.py"):
+        for (name, lineno) in sorted(tracker.imported.items(),
+                                     key=lambda kv: kv[1]):
+            if name not in tracker.used and name not in exported:
+                problems.append(f"{rel}:{lineno}: unused import "
+                                f"'{name}'")
+
+    if rel in ANNOTATED:
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if node.name.startswith("_"):
+                continue
+            args = node.args
+            all_args = args.posonlyargs + args.args + args.kwonlyargs
+            missing = [a.arg for a in all_args
+                       if a.annotation is None
+                       and a.arg not in ("self", "cls")]
+            if missing:
+                problems.append(
+                    f"{rel}:{node.lineno}: public function "
+                    f"'{node.name}' missing annotations: {missing}")
+            if node.returns is None and node.name != "__init__":
+                problems.append(
+                    f"{rel}:{node.lineno}: public function "
+                    f"'{node.name}' missing return annotation")
+
+    if not any(rel.startswith(ok) or ok in rel for ok in PRINT_OK):
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "print"
+                    and not _prints_to_stderr(node)):
+                problems.append(f"{rel}:{node.lineno}: print() to "
+                                "stdout in library code")
+    return problems
+
+
+def _prints_to_stderr(node: ast.Call) -> bool:
+    """Diagnostics on stderr are fine; stdout pollution is the smell."""
+    for kw in node.keywords:
+        if kw.arg == "file" and isinstance(kw.value, ast.Attribute) \
+                and kw.value.attr == "stderr":
+            return True
+    return False
+
+
+def main() -> int:
+    roots = [REPO / "mastic_tpu", REPO / "tests", REPO / "tools"]
+    files = [REPO / "bench.py", REPO / "__graft_entry__.py"]
+    for root in roots:
+        files += sorted(root.rglob("*.py"))
+    problems = []
+    for path in files:
+        problems += check_file(path)
+    for problem in problems:
+        print(problem)
+    print(f"lint: {len(files)} files, {len(problems)} problem(s)")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
