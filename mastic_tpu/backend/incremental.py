@@ -308,24 +308,11 @@ class IncrementalMastic:
                            cw_slice, ctx: bytes, rnd: IncrementalRound):
         """vidpf_jax.eval_step with a runtime-length node-proof binder."""
         vid = self.bm.vidpf
-        (seed_cw, ctrl_cw, w_cw, proof_cw) = cw_slice
+        (_seed_cw, _ctrl_cw, _w_cw, proof_cw) = cw_slice
         (num_reports, num_parents) = parents.ctrl.shape
 
-        ((s_l, s_r), (t_l, t_r)) = vid.extend(ext_rk, parents.seed)
-        sel = parents.ctrl[..., None]
-        s_l = jnp.where(sel, s_l ^ seed_cw[:, None, :], s_l)
-        s_r = jnp.where(sel, s_r ^ seed_cw[:, None, :], s_r)
-        t_l = t_l ^ (parents.ctrl & ctrl_cw[:, None, 0])
-        t_r = t_r ^ (parents.ctrl & ctrl_cw[:, None, 1])
-
-        cs = jnp.stack([s_l, s_r], axis=2).reshape(
-            num_reports, 2 * num_parents, KEY_SIZE)
-        ct = jnp.stack([t_l, t_r], axis=2).reshape(
-            num_reports, 2 * num_parents)
-
-        (next_seed, w, ok) = vid.convert(conv_rk, cs)
-        w = jnp.where(ct[..., None, None],
-                      self.bm.spec.add(w, w_cw[:, None]), w)
+        (next_seed, ct, w, ok) = vid.level_core(ext_rk, conv_rk,
+                                                parents, cw_slice)
 
         # Node proof with runtime-length (BITS, level, path) binder.
         proof_dst = dst(ctx, USAGE_NODE_PROOF)

@@ -27,10 +27,13 @@ import numpy as np
 from ..common import to_le_bytes
 from ..dst import USAGE_CONVERT, USAGE_EXTEND, USAGE_NODE_PROOF, dst
 from ..field import Field
+from ..ops.aes_jax import (bitslice_keys, bitslice_pack,
+                           bitslice_unpack, pack_mask, unpack_mask)
 from ..ops.field_jax import FieldSpec, spec_for
 from ..vidpf import PROOF_SIZE, CorrectionWord
 from .schedule import LevelSchedule
-from .xof_jax import (build_msg, fixed_key_blocks, fixed_key_schedule,
+from .xof_jax import (build_msg, fixed_key_blocks,
+                      fixed_key_blocks_planes, fixed_key_schedule,
                       sample_vec, turboshake_xof)
 
 _U8 = jnp.uint8
@@ -223,15 +226,23 @@ class BatchedVidpf:
             proof=jnp.zeros((num_reports, 1, PROOF_SIZE), _U8),
         )
 
-    def eval_step(self, ext_rk: jax.Array, conv_rk: jax.Array,
-                  parents: EvalState, cw_slice, ctx: bytes,
-                  node_binder: np.ndarray):
-        """One level of the tree: extend every parent, correct, convert
-        and hash both children.  Children are interleaved
+    def level_core(self, ext_rk: jax.Array, conv_rk: jax.Array,
+                   parents: EvalState, cw_slice):
+        """extend + correct + convert for one level (everything except
+        the node proof): returns (next_seed (R, 2N, 16), ct (R, 2N)
+        bool, w plain limbs, ok per child).  Children are interleaved
         (left0, right0, left1, right1, ...), preserving lexicographic
-        order.  Returns (EvalState for the children, ok (R,))."""
-        (seed_cw, ctrl_cw, w_cw, proof_cw) = cw_slice
+        order.
+
+        Large report batches run entirely in the bitsliced plane
+        domain — parent-seed pack to next-seed unpack with no byte
+        round-trips in between (corrections are mask ANDs on packed
+        words).  Small batches use the byte path."""
         (num_reports, num_parents) = parents.ctrl.shape
+        if num_reports >= 32 and num_reports % 32 == 0:
+            return self._level_core_planes(ext_rk, conv_rk, parents,
+                                           cw_slice)
+        (seed_cw, ctrl_cw, w_cw, _proof_cw) = cw_slice
 
         ((s_l, s_r), (t_l, t_r)) = self.extend(ext_rk, parents.seed)
 
@@ -250,6 +261,72 @@ class BatchedVidpf:
         (next_seed, w, ok) = self.convert(conv_rk, cs)
         w = jnp.where(ct[..., None, None],
                       self.spec.add(w, w_cw[:, None]), w)
+        return (next_seed, ct, w, ok)
+
+    def _level_core_planes(self, ext_rk: jax.Array, conv_rk: jax.Array,
+                           parents: EvalState, cw_slice):
+        """Plane-domain level core: one bitslice_pack of the parent
+        seeds in, one bitslice_unpack of the next seeds + payload out."""
+        (seed_cw, ctrl_cw, w_cw, _proof_cw) = cw_slice
+        (num_reports, num_parents) = parents.ctrl.shape
+
+        ext_kp = bitslice_keys(ext_rk)          # (11, 8, 16, W)
+        conv_kp = bitslice_keys(conv_rk)
+        sp = bitslice_pack(parents.seed)        # (8, 16, N, W)
+        pctrl = pack_mask(parents.ctrl)         # (N, W)
+
+        ext = fixed_key_blocks_planes(ext_kp, sp, 2)  # (8,16,N,2,W)
+        s_l = ext[..., 0, :]
+        s_r = ext[..., 1, :]
+        # Control bits are plane (0, byte 0); clear them in the seeds.
+        t_l = s_l[0, 0]                         # (N, W) packed bits
+        t_r = s_r[0, 0]
+        s_l = s_l.at[0, 0].set(jnp.zeros_like(t_l))
+        s_r = s_r.at[0, 0].set(jnp.zeros_like(t_r))
+
+        # Corrections: secret-dependent selects become mask ANDs on
+        # packed words (the same constant-time discipline, denser).
+        cw_planes = bitslice_pack(seed_cw)      # (8, 16, W)
+        sel = cw_planes[:, :, None, :] & pctrl[None, None, :, :]
+        s_l = s_l ^ sel
+        s_r = s_r ^ sel
+        cw_ctrl = pack_mask(ctrl_cw)            # (2, W)
+        t_l = t_l ^ (pctrl & cw_ctrl[0])
+        t_r = t_r ^ (pctrl & cw_ctrl[1])
+
+        cs = jnp.stack([s_l, s_r], axis=3).reshape(
+            (8, 16, 2 * num_parents) + sp.shape[-1:])
+        ct_words = jnp.stack([t_l, t_r], axis=1).reshape(
+            2 * num_parents, -1)
+
+        stream = fixed_key_blocks_planes(conv_kp, cs,
+                                         self.convert_blocks)
+        next_seed = bitslice_unpack(stream[..., 0, :])[:num_reports]
+        # Unpack payload blocks (8, 16, 2N, m-1, W) -> bytes
+        # (R, 2N, (m-1)*16), block-major per node.
+        tail = stream[..., 1:, :]
+        tail = bitslice_unpack(
+            tail.reshape(tail.shape[:2] + (-1,) + tail.shape[-1:]))
+        tail = tail[:num_reports].reshape(
+            num_reports, 2 * num_parents, self.convert_blocks - 1, 16)
+        stream_bytes = tail.reshape(num_reports, 2 * num_parents, -1)
+        (w, ok) = sample_vec(self.spec, stream_bytes, self.VALUE_LEN)
+
+        ct = unpack_mask(ct_words, num_reports)  # (R, 2N)
+        w = jnp.where(ct[..., None, None],
+                      self.spec.add(w, w_cw[:, None]), w)
+        return (next_seed, ct, w, ok)
+
+    def eval_step(self, ext_rk: jax.Array, conv_rk: jax.Array,
+                  parents: EvalState, cw_slice, ctx: bytes,
+                  node_binder: np.ndarray):
+        """One level of the tree: extend every parent, correct, convert
+        and hash both children (see level_core).  Returns (EvalState
+        for the children, ok (R,))."""
+        (_seed_cw, _ctrl_cw, _w_cw, proof_cw) = cw_slice
+        (num_reports, num_parents) = parents.ctrl.shape
+        (next_seed, ct, w, ok) = self.level_core(ext_rk, conv_rk,
+                                                 parents, cw_slice)
 
         proof = self.node_proof(
             ctx, next_seed, jnp.asarray(node_binder),

@@ -128,6 +128,41 @@ def fixed_key_blocks(round_keys: jax.Array, seeds: jax.Array,
     return out.reshape(out.shape[:-2] + (num_blocks * 16,))
 
 
+_BLOCK_PLANES_CACHE: dict[int, np.ndarray] = {}
+
+
+def _block_planes(num_blocks: int) -> np.ndarray:
+    from ..ops.aes_jax import block_index_planes
+
+    cached = _BLOCK_PLANES_CACHE.get(num_blocks)
+    if cached is None:
+        cached = block_index_planes(num_blocks)
+        _BLOCK_PLANES_CACHE[num_blocks] = cached
+    return cached
+
+
+def fixed_key_blocks_planes(key_planes: jax.Array, seed_planes: jax.Array,
+                            num_blocks: int) -> jax.Array:
+    """XofFixedKeyAes128 blocks entirely in the bitsliced plane domain.
+
+    key_planes: (11, 8, 16, W) from bitslice_keys; seed_planes:
+    (8, 16, N..., W).  Returns stream planes (8, 16, N..., num_blocks,
+    W).  The Davies-Meyer construction's byte moves (x = seed ^
+    le128(i); sigma = hi || hi^lo; out = E(sigma) ^ sigma) are all
+    plane-index arithmetic — no pack/unpack at this boundary, which is
+    the point: a level step stays bit-transposed from the parent seeds
+    to the next seeds."""
+    idx = jnp.asarray(_block_planes(num_blocks))   # (m, 8, 16)
+    extra = seed_planes.ndim - 3
+    idx = jnp.moveaxis(idx, 0, -1).reshape(
+        (8, 16) + (1,) * extra + (num_blocks, 1))
+    x = seed_planes[..., None, :] ^ idx            # (8, 16, N..., m, W)
+    lo = x[:, :8]
+    hi = x[:, 8:]
+    sigma = jnp.concatenate([hi, hi ^ lo], axis=1)
+    return aes128_encrypt_bitsliced(key_planes, sigma) ^ sigma
+
+
 def _encrypt_bitsliced_reports(round_keys: jax.Array,
                                sigma: jax.Array) -> jax.Array:
     """AES over (R, N..., 16) blocks with per-report keys (R, 11, 16),

@@ -14,6 +14,7 @@ present in the dict, else the default (reference examples.py:26-34,
 spec draft-mouris-cfrg-mastic.md:1535-1572).
 """
 
+import time
 from typing import Optional, Sequence
 
 import jax
@@ -254,11 +255,17 @@ class HeavyHittersRun:
         self.prev_agg_params: list = []
         self.heavy_hitters: list = []
         self.metrics: list = []  # one RoundMetrics per completed level
+        self.profile_dir: Optional[str] = None  # jax.profiler target
         self.done = False
 
     def step(self) -> bool:
         """Run one level's aggregation round.  Returns True while more
-        rounds remain."""
+        rounds remain.
+
+        Tracing: when `self.profile_dir` is set (a directory path), the
+        round executes under jax.profiler.trace — open the result with
+        TensorBoard / xprof.  Per-round wall-clock always lands in
+        metrics.extra["round_wall_ms"]."""
         if self.done:
             return False
         if not self.prefixes:
@@ -267,13 +274,26 @@ class HeavyHittersRun:
         level = self.level
         agg_param = (level, tuple(self.prefixes), level == 0)
         assert self.mastic.is_valid(agg_param, self.prev_agg_params)
-        if self.runner is not None:
-            agg_result = self.runner.round(agg_param,
-                                           metrics_out=self.metrics)
-        else:
-            agg_result = run_round(self.bm, self.verify_key, self.ctx,
-                                   agg_param, self.batch, self.reports,
-                                   metrics_out=self.metrics)
+        trace = (jax.profiler.trace(self.profile_dir)
+                 if self.profile_dir else None)
+        t0 = time.perf_counter()
+        if trace is not None:
+            trace.__enter__()
+        try:
+            if self.runner is not None:
+                agg_result = self.runner.round(agg_param,
+                                               metrics_out=self.metrics)
+            else:
+                agg_result = run_round(self.bm, self.verify_key,
+                                       self.ctx, agg_param, self.batch,
+                                       self.reports,
+                                       metrics_out=self.metrics)
+        finally:
+            if trace is not None:
+                trace.__exit__(None, None, None)
+        if self.metrics:
+            self.metrics[-1].extra["round_wall_ms"] = round(
+                (time.perf_counter() - t0) * 1e3, 2)
         self.prev_agg_params.append(agg_param)
 
         survivors = [

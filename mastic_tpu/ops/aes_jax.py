@@ -20,6 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..aes import SBOX, _gf_mul
+from .sbox_tower import sbox_planes_tower
 
 _U8 = jnp.uint8
 
@@ -101,14 +102,21 @@ def _sbox_planes(x, one=1):
 
 
 def sub_bytes(x):
-    """Apply the AES S-box elementwise to a uint8 array."""
-    return _unplanes(_sbox_planes(_planes(x)))
+    """Apply the AES S-box elementwise to a uint8 array (tower-field
+    circuit, ops/sbox_tower.py — ~4x fewer gates than the x^254
+    chain above, which is kept as independent documentation of the
+    inversion)."""
+    return _unplanes(sbox_planes_tower(_planes(x), 1))
 
 
-# Lock the circuit against the table at import (numpy path).
-_check = _unplanes(_sbox_planes(_planes(np.arange(256, dtype=np.uint8))))
-assert bytes(_check) == SBOX, "bitsliced S-box circuit diverges from table"
-del _check
+# Lock BOTH circuits against the table at import (numpy path).
+for _circuit in (
+        lambda p: _sbox_planes(p),
+        lambda p: sbox_planes_tower(p, 1),
+):
+    _check = _unplanes(_circuit(_planes(np.arange(256, dtype=np.uint8))))
+    assert bytes(_check) == SBOX, "S-box circuit diverges from table"
+del _check, _circuit
 
 
 def _xtime(v):
@@ -242,6 +250,41 @@ def bitslice_keys(round_keys: jax.Array) -> jax.Array:
     return jnp.moveaxis(bitslice_pack(round_keys), 2, 0)
 
 
+def pack_mask(bits: jax.Array) -> jax.Array:
+    """Pack a bool array (M, ...) along its leading axis:
+    -> (..., M//32) uint32 select-mask words (bit j of word w = element
+    32*w + j), for plane-domain lane selects (x ^ (planes & mask))."""
+    m = bits.shape[0]
+    assert m % 32 == 0
+    xr = bits.reshape((m // 32, 32) + bits.shape[1:]).astype(_U32)
+    shifts = jnp.arange(32, dtype=_U32).reshape(
+        (1, 32) + (1,) * (bits.ndim - 1))
+    words = jnp.sum(xr << shifts, axis=1, dtype=_U32)  # (W, ...)
+    return jnp.moveaxis(words, 0, -1)
+
+
+def unpack_mask(words: jax.Array, m: int) -> jax.Array:
+    """Inverse of pack_mask: (..., W) uint32 -> (m, ...) bool."""
+    shifts = jnp.arange(32, dtype=_U32).reshape(
+        (1,) * (words.ndim - 1) + (1, 32))
+    bits = (words[..., None] >> shifts) & _U32(1)   # (..., W, 32)
+    bits = bits.reshape(words.shape[:-1] + (-1,))   # (..., 32W)
+    return jnp.moveaxis(bits, -1, 0)[:m].astype(bool)
+
+
+def block_index_planes(num_blocks: int) -> np.ndarray:
+    """le128(i) for i < num_blocks as plane masks: (num_blocks, 8, 16)
+    uint32, each entry 0 or 0xFFFFFFFF (XOR-constant in plane form)."""
+    out = np.zeros((num_blocks, 8, 16), np.uint32)
+    for i in range(num_blocks):
+        le = i.to_bytes(16, "little")
+        for b in range(8):
+            for k in range(16):
+                if (le[k] >> b) & 1:
+                    out[i, b, k] = 0xFFFFFFFF
+    return out
+
+
 def _xtime_planes(v: jax.Array) -> jax.Array:
     """xtime on a (8, ...) plane stack: shift planes up one, fold the
     top plane into the 0x1B taps (bits 1, 3, 4; bit 0 is the rolled-in
@@ -262,7 +305,8 @@ def _mix_columns_planes(s: jax.Array) -> jax.Array:
 
 
 def _sub_shift_planes(s: jax.Array) -> jax.Array:
-    sb = jnp.stack(_sbox_planes([s[b] for b in range(8)], one=_ONES32))
+    sb = jnp.stack(sbox_planes_tower([s[b] for b in range(8)],
+                                     _ONES32))
     return sb[:, _SHIFT_ROWS_ARR]
 
 

@@ -149,3 +149,48 @@ def test_pack_path_bits_matches_host():
         got = np.asarray(pack_path_bits(jnp.asarray(bits)))
         for r in range(4):
             assert got[r].tobytes() == pack_bits(list(bits[r]))
+
+
+def test_level_core_plane_path_matches_byte_path():
+    """The bitsliced plane-domain level core (R >= 32) against the
+    byte path on identical inputs, including correction selects and
+    the rejection mask."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mastic_tpu.backend.vidpf_jax import BatchedVidpf, EvalState
+    from mastic_tpu.field import Field64
+
+    vid = BatchedVidpf(Field64, 8, 2)
+    rng = np.random.default_rng(9)
+    (r, n) = (64, 3)
+    nonces = jnp.asarray(rng.integers(0, 256, (r, 16), np.uint8))
+    (ext_rk, conv_rk) = vid.roundkeys(b"plane test", nonces)
+    parents = EvalState(
+        seed=jnp.asarray(rng.integers(0, 256, (r, n, 16), np.uint8)),
+        ctrl=jnp.asarray(rng.integers(0, 2, (r, n)).astype(bool)),
+        w=jnp.zeros((r, n, 2, 4), jnp.uint32),
+        proof=jnp.zeros((r, n, 32), jnp.uint8))
+    cw = (jnp.asarray(rng.integers(0, 256, (r, 16), np.uint8)),
+          jnp.asarray(rng.integers(0, 2, (r, 2)).astype(bool)),
+          jnp.asarray(rng.integers(0, 1 << 16, (r, 2, 4),
+                                   dtype=np.uint32)),
+          jnp.asarray(rng.integers(0, 256, (r, 32), np.uint8)))
+
+    (ps, pt, pw, pok) = vid._level_core_planes(ext_rk, conv_rk,
+                                               parents, cw)
+    # Byte path: slice per-report batches below the plane threshold.
+    for lo in (0, 32):
+        sub = EvalState(seed=parents.seed[lo:lo + 16],
+                        ctrl=parents.ctrl[lo:lo + 16],
+                        w=parents.w[lo:lo + 16],
+                        proof=parents.proof[lo:lo + 16])
+        sub_cw = tuple(x[lo:lo + 16] for x in cw)
+        (bs, bt, bw, bok) = vid.level_core(ext_rk[lo:lo + 16],
+                                           conv_rk[lo:lo + 16],
+                                           sub, sub_cw)
+        s = slice(lo, lo + 16)
+        assert (np.asarray(ps[s]) == np.asarray(bs)).all()
+        assert (np.asarray(pt[s]) == np.asarray(bt)).all()
+        assert (np.asarray(pw[s]) == np.asarray(bw)).all()
+        assert (np.asarray(pok[s]) == np.asarray(bok)).all()
