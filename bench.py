@@ -37,12 +37,25 @@ tiny sanity / compile / warmup / measure / each config) stamps
 progress to stderr and updates a shared partial-result record; the
 watchdog prints the best measurement completed so far instead of a
 bare zero, with the failing phase named in "error".
+
+Tunnel resilience (the remote-TPU link can be down at snapshot time):
+  * device attach is probed in a SUBPROCESS with a hard per-attempt
+    timeout and retries before the main process commits to jax.devices()
+    (an in-process attach hang is unrecoverable — it ignores signals);
+  * every successful full run persists its result to
+    BENCH_LAST_GOOD.json (value, configs, git rev, timestamp); when
+    attach fails, that record is emitted with "cached": true and its
+    provenance, so a flaky tunnel degrades the round's number to
+    "last verified" instead of erasing it;
+  * the cached record is pre-seeded into the fail-open PARTIAL *before*
+    attach, so even a watchdog firing mid-attach emits it.
 """
 
 import argparse
 import json
 import os
 import socket
+import subprocess
 import sys
 import threading
 import time
@@ -74,6 +87,127 @@ def emit(error: str | None = None) -> None:
     if error is not None:
         out["error"] = f"{error} (last phase: {phase})"
     print(json.dumps(out), flush=True)
+
+
+_LAST_GOOD_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_LAST_GOOD.json")
+
+
+def load_last_good() -> dict | None:
+    try:
+        with open(_LAST_GOOD_PATH) as fh:
+            rec = json.load(fh)
+        if (isinstance(rec, dict)
+                and isinstance(rec.get("value"), (int, float))
+                and rec["value"] > 0):
+            return rec
+        return None
+    except Exception:
+        # A corrupt cache (e.g. a partial write cut off by the
+        # watchdog's os._exit) must never stop a fresh measurement.
+        return None
+
+
+def save_last_good() -> None:
+    """Persist the just-measured full result with provenance.
+
+    Headline fields are always fresh here (only called after a fresh
+    on-chip measurement).  Configs are persisted only when they are
+    real measurements: an errored or empty config phase falls back to
+    the previous record's configs, carrying THEIR provenance forward —
+    never re-stamped under this run's revision."""
+    rec = {k: v for (k, v) in PARTIAL.items()
+           if k not in ("phase", "cached", "cached_provenance",
+                        "configs", "configs_provenance")}
+    configs = PARTIAL.get("configs")
+    clean = ({k: v for (k, v) in configs.items() if k != "error"}
+             if isinstance(configs, dict) else {})
+    if clean:
+        rec["configs"] = clean
+        prov = PARTIAL.get("configs_provenance")
+        if prov:  # configs were seeded from an older run, keep its rev
+            rec["configs_provenance"] = prov
+    else:
+        old = load_last_good()
+        old_configs = (old or {}).get("configs")
+        old_clean = ({k: v for (k, v) in old_configs.items()
+                      if k != "error"}
+                     if isinstance(old_configs, dict) else {})
+        if old_clean:
+            rec["configs"] = old_clean
+            rec["configs_provenance"] = old.get("configs_provenance") \
+                or {"git_rev": old.get("git_rev", "unknown"),
+                    "timestamp": old.get("timestamp", "unknown")}
+    rec["timestamp"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    try:
+        rev = subprocess.run(
+            ["git", "-C", os.path.dirname(_LAST_GOOD_PATH), "rev-parse",
+             "HEAD"], capture_output=True, text=True, timeout=10)
+        rec["git_rev"] = rev.stdout.strip() or "unknown"
+    except Exception:
+        rec["git_rev"] = "unknown"
+    tmp = _LAST_GOOD_PATH + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(rec, fh, indent=1)
+        fh.write("\n")
+    os.replace(tmp, _LAST_GOOD_PATH)
+
+
+def seed_from_cache() -> dict | None:
+    """Pre-seed the fail-open record from the last verified full run,
+    clearly marked as cached with its provenance."""
+    last = load_last_good()
+    if last is None:
+        return None
+    PARTIAL["value"] = last["value"]
+    PARTIAL["vs_baseline"] = last.get("vs_baseline", 0.0)
+    if isinstance(last.get("configs"), dict):
+        PARTIAL["configs"] = last["configs"]
+        # Configs keep the revision they were measured at (may be
+        # older than the headline's if a headline-only run re-saved).
+        PARTIAL["configs_provenance"] = last.get("configs_provenance") \
+            or {"git_rev": last.get("git_rev", "unknown"),
+                "timestamp": last.get("timestamp", "unknown")}
+    PARTIAL["cached"] = True
+    PARTIAL["cached_provenance"] = {
+        "git_rev": last.get("git_rev", "unknown"),
+        "timestamp": last.get("timestamp", "unknown"),
+        "reports": last.get("reports"),
+        "frontier": last.get("frontier"),
+    }
+    return last
+
+
+def probe_attach(timeout: float = 60.0, retries: int = 3) -> bool:
+    """Probe jax.devices() in a subprocess with a hard timeout.
+
+    An in-process attach to a dead tunnel blocks forever in C++ and
+    ignores signals, so the main process must never be the first to
+    try.  A successful probe also warms the tunnel, making the real
+    attach fast."""
+    code = "import jax; d = jax.devices(); print(d[0].platform)"
+    for attempt in range(1, retries + 1):
+        stamp("attach-probe", attempt=f"{attempt}/{retries}",
+              timeout_s=int(timeout))
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", code], capture_output=True,
+                text=True, timeout=timeout)
+        except subprocess.TimeoutExpired:
+            stamp("attach-probe-timeout", attempt=attempt)
+            continue
+        platform = proc.stdout.strip()
+        if proc.returncode == 0 and platform not in ("", "cpu"):
+            stamp("attach-probe-ok", platform=platform)
+            return True
+        # rc 0 + platform "cpu" = jax fell back to the host backend
+        # (fast-failing tunnel): that is NOT the chip — treating it as
+        # one would record a bogus CPU rate over the real last-good.
+        stamp("attach-probe-failed", rc=proc.returncode,
+              platform=platform or "?",
+              err=proc.stderr.strip().splitlines()[-1][:120]
+              if proc.stderr.strip() else "")
+    return False
 
 
 def _watchdog(seconds: float):
@@ -413,10 +547,34 @@ def main():
                         help="force the CPU backend (local sanity)")
     parser.add_argument("--headline-only", action="store_true",
                         help="skip the per-config benches")
+    parser.add_argument("--keccak-unroll", type=int, default=None,
+                        help="Keccak round-scan unroll factor "
+                        "(sets MASTIC_KECCAK_UNROLL; default 4 unless "
+                        "the env var is already set; 1 = cheapest "
+                        "compile)")
     parser.add_argument("--watchdog", type=float, default=1500.0)
+    parser.add_argument("--attach-timeout", type=float, default=60.0)
+    parser.add_argument("--attach-retries", type=int, default=3)
     args = parser.parse_args()
 
     timer = _watchdog(args.watchdog)
+    # The unroll lever must be in the environment before any
+    # mastic_tpu.ops import (ops/keccak_jax.py reads it at import).
+    # An explicit --keccak-unroll wins over an inherited env var; the
+    # env var wins over the flag's default.
+    if args.keccak_unroll is not None:
+        os.environ["MASTIC_KECCAK_UNROLL"] = str(args.keccak_unroll)
+    else:
+        os.environ.setdefault("MASTIC_KECCAK_UNROLL", "4")
+
+    # Pre-seed the fail-open record from the last verified run BEFORE
+    # anything that can hang, so every exit path has a nonzero number
+    # when one has ever been measured.
+    cached = seed_from_cache()
+    if cached is not None:
+        stamp("cache-seeded", value=cached["value"],
+              rev=cached.get("git_rev", "?")[:12])
+
     stamp("import-jax")
     if args.cpu:
         os.environ["JAX_PLATFORMS"] = "cpu"
@@ -434,9 +592,31 @@ def main():
     stamp("scalar-baseline")
     base = scalar_rate(bits=args.bits)
     PARTIAL["scalar_evals_per_sec"] = round(base, 1)
+    if cached is not None and base > 0:
+        PARTIAL["vs_baseline"] = round(PARTIAL["value"] / base, 1)
+
+    # Subprocess probe first: a dead tunnel hangs the first in-process
+    # jax.devices() beyond any recoverable point (r1 and r3 both lost
+    # their number to exactly that).  Only the tunnel backend needs
+    # probing — when JAX_PLATFORMS steers away from it (the config
+    # override above), there is nothing to hang on, and the probe
+    # child could not see that override anyway (the ambient
+    # sitecustomize re-pins the child to the tunnel at config level).
+    tunnel_expected = not requested or "axon" in requested.split(",")
+    if not args.cpu and tunnel_expected:
+        if not probe_attach(args.attach_timeout, args.attach_retries):
+            timer.cancel()
+            emit(error="device attach probe failed "
+                 f"({args.attach_retries}x{args.attach_timeout:.0f}s; "
+                 "tunnel down)")
+            # Nonzero so wrappers gating on exit status see that no
+            # fresh measurement happened (the JSON line still carries
+            # the cached number + provenance when one exists).
+            sys.exit(3)
     stamp("device-attach")
     devices = jax.devices()
     stamp("device-up", devices=devices)
+    on_chip = devices[0].platform != "cpu"
 
     from mastic_tpu import MasticCount
     from mastic_tpu.backend.mastic_jax import BatchedMastic
@@ -448,9 +628,14 @@ def main():
     tiny = SteadyState(bm, 64, 8, args.bits)
     tiny_compile = tiny.compile()
     tiny_rate = tiny.run(4)
-    PARTIAL["value"] = round(tiny_rate, 1)
-    PARTIAL["vs_baseline"] = round(tiny_rate / base, 1)
-    PARTIAL["note"] = "tiny-shape (64x8) fallback rate"
+    PARTIAL["tiny_rate_evals_per_sec"] = round(tiny_rate, 1)
+    if cached is None:
+        # Without a last-good record the tiny rate is the best
+        # fallback; with one, the cached full-shape number stays (a
+        # 64x8 tile underfills the chip and would read as a regression).
+        PARTIAL["value"] = round(tiny_rate, 1)
+        PARTIAL["vs_baseline"] = round(tiny_rate / base, 1)
+        PARTIAL["note"] = "tiny-shape (64x8) fallback rate"
     stamp("tiny-sanity-done", rate=f"{tiny_rate:.0f}",
           compile_s=f"{tiny_compile:.1f}")
 
@@ -463,11 +648,22 @@ def main():
     rate = full.run(args.steps)
 
     PARTIAL.pop("note", None)
+    # A fresh full measurement supersedes any cached pre-seed.  Under
+    # --headline-only, cached configs stay with their own
+    # configs_provenance: a verified older per-config record beats
+    # discarding it, but it keeps the revision it was measured at.
+    PARTIAL.pop("cached", None)
+    PARTIAL.pop("cached_provenance", None)
+    if not args.headline_only:
+        PARTIAL.pop("configs", None)
+        PARTIAL.pop("configs_provenance", None)
     PARTIAL["value"] = round(rate, 1)
     PARTIAL["vs_baseline"] = round(rate / base, 1)
     PARTIAL["compile_seconds"] = round(compile_s, 1)
     PARTIAL["reports"] = args.reports
     PARTIAL["frontier"] = args.frontier
+    PARTIAL["keccak_unroll"] = int(
+        os.environ.get("MASTIC_KECCAK_UNROLL", "1"))
 
     if not args.headline_only:
         try:
@@ -475,6 +671,9 @@ def main():
         except Exception as exc:  # fail open per config
             PARTIAL.setdefault("configs", {})["error"] = \
                 f"{type(exc).__name__}: {exc}"
+    if not args.cpu and on_chip:
+        save_last_good()
+        stamp("last-good-saved", path=_LAST_GOOD_PATH)
     timer.cancel()
     stamp("done", rate=f"{rate:.0f}")
     emit()

@@ -111,6 +111,11 @@ def count_round_bytes(metrics: RoundMetrics, mastic, agg_param,
 
     use_jr = mastic.flp.JOINT_RAND_LEN > 0
     (_level, _prefixes, do_weight_check) = agg_param
+    # Uploads are paid once per collection, on the round the reports
+    # enter it — which both drivers mark with the weight check (level 0
+    # of heavy hitters; the single attribute-metrics round).
+    if do_weight_check:
+        metrics.bytes_upload = num_reports * upload_bytes(mastic)
     metrics.bytes_prep_shares = \
         2 * num_reports * wire.prep_share_size(mastic, agg_param)
     if do_weight_check and use_jr:

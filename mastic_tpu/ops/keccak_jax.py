@@ -27,9 +27,11 @@ RATE = 168  # TurboSHAKE128 rate in bytes (21 lanes)
 _U32 = jnp.uint32
 
 # Round-loop unroll factor for the permutation scan (see keccak_p1600).
-# 1 keeps compiles cheap (the CPU test suite compiles every program
-# once); bench.py raises it on the real chip where fusing rounds
-# avoids HBM round-trips of the scan carry.
+# Read once at import.  The default 1 keeps compiles cheap (the CPU
+# test suite compiles every program once); bench.py exports
+# MASTIC_KECCAK_UNROLL (default 4, --keccak-unroll) before importing
+# this module so chip runs fuse rounds and skip the scan carry's HBM
+# round-trips.
 UNROLL = int(os.environ.get("MASTIC_KECCAK_UNROLL", "1"))
 
 

@@ -76,6 +76,20 @@ def test_op_model_and_bytes(incremental) -> None:
         assert metrics.keccak_node_proofs == metrics.node_evals
 
         # Channel bytes from the conformance-locked size formulas.
+        # Upload is paid once, on the round the reports enter
+        # (weight-check round), and its size must match what the
+        # wire-encoded report actually serializes to.
+        if do_wc:
+            from mastic_tpu import testvec_codec
+            from mastic_tpu.metrics import upload_bytes
+            (nonce0, ps0, shares0) = reports[1]
+            encoded = len(testvec_codec.encode_public_share(m, ps0)) \
+                + len(testvec_codec.encode_input_share(m, shares0[0])) \
+                + len(testvec_codec.encode_input_share(m, shares0[1]))
+            assert upload_bytes(m) == encoded
+            assert metrics.bytes_upload == num * encoded
+        else:
+            assert metrics.bytes_upload == 0
         assert metrics.bytes_prep_shares == \
             2 * num * wire.prep_share_size(m, agg_param)
         assert metrics.bytes_agg_shares == \
