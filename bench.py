@@ -153,11 +153,16 @@ def save_last_good() -> None:
     os.replace(tmp, _LAST_GOOD_PATH)
 
 
-def seed_from_cache() -> dict | None:
+def seed_from_cache(bits: int, reports: int) -> dict | None:
     """Pre-seed the fail-open record from the last verified full run,
-    clearly marked as cached with its provenance."""
+    clearly marked as cached with its provenance.  A record measured
+    at a different shape is not comparable (different tree depth /
+    tile) and is left unused rather than emitted under this run's
+    metric name."""
     last = load_last_good()
     if last is None:
+        return None
+    if last.get("bits") != bits or last.get("reports") != reports:
         return None
     PARTIAL["value"] = last["value"]
     PARTIAL["vs_baseline"] = last.get("vs_baseline", 0.0)
@@ -570,7 +575,7 @@ def main():
     # Pre-seed the fail-open record from the last verified run BEFORE
     # anything that can hang, so every exit path has a nonzero number
     # when one has ever been measured.
-    cached = seed_from_cache()
+    cached = seed_from_cache(args.bits, args.reports)
     if cached is not None:
         stamp("cache-seeded", value=cached["value"],
               rev=cached.get("git_rev", "?")[:12])
@@ -662,6 +667,7 @@ def main():
     PARTIAL["compile_seconds"] = round(compile_s, 1)
     PARTIAL["reports"] = args.reports
     PARTIAL["frontier"] = args.frontier
+    PARTIAL["bits"] = args.bits
     PARTIAL["keccak_unroll"] = int(
         os.environ.get("MASTIC_KECCAK_UNROLL", "1"))
 

@@ -22,7 +22,8 @@ from ..common import to_le_bytes
 from ..dst import (USAGE_EVAL_PROOF, USAGE_JOINT_RAND,
                    USAGE_JOINT_RAND_PART, USAGE_JOINT_RAND_SEED,
                    USAGE_ONEHOT_CHECK, USAGE_PAYLOAD_CHECK,
-                   USAGE_PROOF_SHARE, USAGE_QUERY_RAND, dst_alg)
+                   USAGE_PROOF_SHARE, USAGE_PROVE_RAND,
+                   USAGE_QUERY_RAND, dst_alg)
 from ..flp.flp_jax import BatchedFlp
 from ..mastic import Mastic
 from ..ops.field_jax import field_sum, spec_for
@@ -133,6 +134,11 @@ class BatchedMastic:
         return self._expand_vec(seeds, USAGE_PROOF_SHARE, ctx, (),
                                 self.m.flp.PROOF_LEN, seeds.shape[:-1])
 
+    def prove_rand(self, ctx: bytes, seeds: jax.Array):
+        return self._expand_vec(seeds, USAGE_PROVE_RAND, ctx, (),
+                                self.m.flp.PROVE_RAND_LEN,
+                                seeds.shape[:-1])
+
     def query_rand(self, verify_key: bytes, ctx: bytes,
                    nonces: jax.Array, level: int):
         return self._expand_vec(
@@ -159,6 +165,82 @@ class BatchedMastic:
         return self._expand_vec(seeds, USAGE_JOINT_RAND, ctx, (),
                                 self.m.flp.JOINT_RAND_LEN,
                                 seeds.shape[:-1])
+
+    # -- batched client shard (scalar: mastic.py:100-152) ----------
+
+    def shard_device(self, ctx: bytes, alphas: jax.Array,
+                     betas: jax.Array, nonces: jax.Array,
+                     rand: jax.Array) -> tuple:
+        """Batched client sharding: the whole client fleet's report
+        generation in one program (scalar twin: Mastic.shard — itself
+        the unified path over reference mastic.py:103-185).
+
+        alphas (R, BITS) bool; betas (R, VALUE_LEN, n) plain limbs
+        with the counter 1 prepended (beta = [1] || encode(weight));
+        nonces (R, 16); rand (R, RAND_SIZE) uint8 split exactly as the
+        scalar layer splits it, so identical bytes produce identical
+        reports (tests/test_chunked.py locks this bit-exactly).
+
+        Returns (ReportBatch, ok): lanes where XOF rejection sampling
+        fired carry garbage and must be re-sharded via the scalar
+        layer (same fallback contract as the aggregator side).
+        """
+        use_jr = self.m.flp.JOINT_RAND_LEN > 0
+        vs = self.m.vidpf.RAND_SIZE
+        vidpf_rand = rand[:, :vs]
+        prove_seed = rand[:, vs:vs + SEED_SIZE]
+        helper_seed = rand[:, vs + SEED_SIZE:vs + 2 * SEED_SIZE]
+        leader_seed = (rand[:, vs + 2 * SEED_SIZE:vs + 3 * SEED_SIZE]
+                       if use_jr else None)
+
+        (cws, keys, ok) = self.vidpf.gen(alphas, betas, ctx, nonces,
+                                         vidpf_rand)
+
+        joint_rand = None
+        peer_parts: tuple = (None, None)
+        if use_jr:
+            parts = []
+            for (agg_id, seed) in ((0, leader_seed), (1, helper_seed)):
+                (bs, bok) = self.vidpf.get_beta_share(
+                    agg_id, cws, keys[:, agg_id], ctx, nonces)
+                ok = ok & bok
+                parts.append(self.joint_rand_part(
+                    ctx, seed, bs[..., 1:, :], nonces))
+            jr_seed = self.joint_rand_seed(ctx, parts[0], parts[1])
+            (joint_rand, jok) = self.joint_rand(ctx, jr_seed)
+            ok = ok & jok
+            # Each party's input share carries the PEER's part.
+            peer_parts = (parts[1], parts[0])
+
+        (prove_rand, pok) = self.prove_rand(ctx, prove_seed)
+        ok = ok & pok
+        proof = self.bflp.prove(betas[..., 1:, :], prove_rand,
+                                joint_rand)
+        (helper_share, hok) = self.helper_proof_share(ctx, helper_seed)
+        ok = ok & hok
+        leader_proofs = self.spec.sub(proof, helper_share)
+
+        batch = ReportBatch(
+            nonces=nonces, cws=cws, keys=keys,
+            leader_proofs=leader_proofs, helper_seeds=helper_seed,
+            leader_seeds=leader_seed, peer_parts=peer_parts)
+        return (batch, ok)
+
+    def encode_measurements(self, measurements: list) -> tuple:
+        """Host-side encoding of [(alpha path, weight)] into the
+        shard_device inputs (alphas bool array, betas plain limbs)."""
+        flp = self.m.flp
+        num = len(measurements)
+        bits = self.m.vidpf.BITS
+        alphas = np.zeros((num, bits), bool)
+        betas = np.zeros((num, self.m.vidpf.VALUE_LEN,
+                          self.spec.num_limbs), np.uint32)
+        for (r, (alpha, weight)) in enumerate(measurements):
+            alphas[r] = alpha
+            beta = [self.m.field(1)] + flp.encode(weight)
+            for (j, el) in enumerate(beta):
+                betas[r, j] = self.spec.int_to_limbs(el.int())
+        return (alphas, betas)
 
     # -- the checks (scalar: mastic.py:219-247) --------------------
 

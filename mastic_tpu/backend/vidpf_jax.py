@@ -30,11 +30,12 @@ from ..field import Field
 from ..ops.aes_jax import (bitslice_keys, bitslice_pack,
                            bitslice_unpack, pack_mask, unpack_mask)
 from ..ops.field_jax import FieldSpec, spec_for
+from ..ops.keccak_jax import turbo_shake128_dynamic
 from ..vidpf import PROOF_SIZE, CorrectionWord
 from .schedule import LevelSchedule
 from .xof_jax import (build_msg, fixed_key_blocks,
                       fixed_key_blocks_planes, fixed_key_schedule,
-                      sample_vec, turboshake_xof)
+                      sample_vec, ts_prefix, turboshake_xof)
 
 _U8 = jnp.uint8
 
@@ -137,6 +138,30 @@ class BatchedVidpf:
 
     # -- key generation (client side; reference vidpf.py:103-211) --
 
+    def _node_proof_dynamic(self, ctx: bytes, seeds: jax.Array,
+                            path: jax.Array, i: jax.Array) -> jax.Array:
+        """Node proof with the level index traced: the message is
+        prefix | seed | BITS | le16(i) | packed path, hashed over its
+        runtime length (path bytes = i//8 + 1).  Byte-exact vs the
+        static node_proof for every level (the dynamic sponge masks
+        the capacity tail)."""
+        num_reports = seeds.shape[0]
+        prefix = np.frombuffer(
+            ts_prefix(dst(ctx, USAGE_NODE_PROOF), KEY_SIZE), np.uint8)
+        bits_le = np.frombuffer(to_le_bytes(self.BITS, 2), np.uint8)
+        i_le = jnp.stack([i & 0xFF, (i >> 8) & 0xFF]).astype(_U8)
+        msg = jnp.concatenate([
+            jnp.broadcast_to(jnp.asarray(prefix),
+                             (num_reports, prefix.shape[0])),
+            seeds,
+            jnp.broadcast_to(jnp.asarray(bits_le), (num_reports, 2)),
+            jnp.broadcast_to(i_le, (num_reports, 2)),
+            path,
+        ], axis=-1)
+        length = prefix.shape[0] + KEY_SIZE + 4 + i // 8 + 1
+        return turbo_shake128_dynamic(msg, jnp.int32(length), 1,
+                                      PROOF_SIZE)
+
     def gen(self, alphas: jax.Array, betas: jax.Array, ctx: bytes,
             nonces: jax.Array, rand: jax.Array):
         """Batched VIDPF key generation.
@@ -144,22 +169,42 @@ class BatchedVidpf:
         alphas (R, BITS) bool; betas (R, VALUE_LEN, n) plain limbs;
         nonces (R, 16); rand (R, 32) uint8.
         Returns (BatchedCorrectionWords, keys (R, 2, 16), ok (R,)).
+
+        The level loop runs under lax.scan — the per-level body is
+        identical and every shape is level-independent (the one
+        varying quantity, the node-proof binder's packed on-path
+        prefix, is precomputed per level and hashed with the
+        runtime-length sponge), so the compiled program is O(1) in
+        BITS rather than a BITS-times-unrolled graph (a 64-bit client
+        program previously took minutes of XLA compile; the chain
+        itself is sequential either way, reference vidpf.py:136-209).
         """
         (num_reports, bits) = alphas.shape
         assert bits == self.BITS
         (ext_rk, conv_rk) = self.roundkeys(ctx, nonces)
 
         keys = jnp.stack([rand[:, :KEY_SIZE], rand[:, KEY_SIZE:]], axis=1)
-        seed = [keys[:, 0], keys[:, 1]]
-        ctrl = [jnp.zeros(num_reports, bool), jnp.ones(num_reports, bool)]
-        ok = jnp.ones(num_reports, bool)
 
-        (cw_seed, cw_ctrl, cw_w, cw_proof) = ([], [], [], [])
-        for i in range(bits):
-            bit = alphas[:, i]
+        # Per-level packed on-path prefixes: row i equals
+        # pack_path_bits(alphas[:, :i+1]) zero-extended to capacity
+        # (MSB-first packing => masking trailing bytes/bits of the
+        # full packing).
+        path_cap = (bits + 7) // 8
+        packed_full = pack_path_bits(alphas)            # (R, cap)
+        lvl = jnp.arange(bits, dtype=jnp.int32)[:, None]
+        byte_idx = jnp.arange(path_cap, dtype=jnp.int32)[None, :]
+        keep = jnp.left_shift(0xFF, 7 - (lvl % 8)) & 0xFF
+        byte_mask = jnp.where(
+            byte_idx * 8 + 7 <= lvl, 0xFF,
+            jnp.where(byte_idx * 8 <= lvl, keep, 0)).astype(_U8)
+        level_paths = packed_full[None] & byte_mask[:, None, :]
 
-            ((s0l, s0r), (t0l, t0r)) = self.extend(ext_rk, seed[0])
-            ((s1l, s1r), (t1l, t1r)) = self.extend(ext_rk, seed[1])
+        def body(carry, xs):
+            (s0, s1, t0, t1, ok) = carry
+            (bit, path, i) = xs
+
+            ((s0l, s0r), (t0l, t0r)) = self.extend(ext_rk, s0)
+            ((s1l, s1r), (t1l, t1r)) = self.extend(ext_rk, s1)
 
             # The losing child's seeds are forced to collide; control
             # corrections make on-path ctrl bits shares of 1.
@@ -174,41 +219,44 @@ class BatchedVidpf:
             t1k = jnp.where(bit, t1r, t1l)
             ctrl_cw_keep = jnp.where(bit, ctrl_cw_r, ctrl_cw_l)
 
-            s0k = jnp.where(ctrl[0][:, None], s0k ^ seed_cw, s0k)
-            t0k = t0k ^ (ctrl[0] & ctrl_cw_keep)
-            s1k = jnp.where(ctrl[1][:, None], s1k ^ seed_cw, s1k)
-            t1k = t1k ^ (ctrl[1] & ctrl_cw_keep)
+            s0k = jnp.where(t0[:, None], s0k ^ seed_cw, s0k)
+            t0k = t0k ^ (t0 & ctrl_cw_keep)
+            s1k = jnp.where(t1[:, None], s1k ^ seed_cw, s1k)
+            t1k = t1k ^ (t1 & ctrl_cw_keep)
 
             (seed0, w0, ok0) = self.convert(conv_rk, s0k)
             (seed1, w1, ok1) = self.convert(conv_rk, s1k)
-            seed = [seed0, seed1]
-            ctrl = [t0k, t1k]
             ok = ok & ok0 & ok1
 
             # Payload correction: on-path shares must sum to beta.
             w_cw = self.spec.add(self.spec.sub(betas, w0), w1)
-            w_cw = jnp.where(ctrl[1][:, None, None],
+            w_cw = jnp.where(t1k[:, None, None],
                              self.spec.neg(w_cw), w_cw)
 
             # Node-proof correction, binding the on-path prefix.
-            binder = build_msg(
-                (num_reports,),
-                to_le_bytes(self.BITS, 2) + to_le_bytes(i, 2),
-                pack_path_bits(alphas[:, :i + 1]))
             proof_cw = \
-                self.node_proof(ctx, seed[0], binder, (num_reports,)) ^ \
-                self.node_proof(ctx, seed[1], binder, (num_reports,))
+                self._node_proof_dynamic(ctx, seed0, path, i) ^ \
+                self._node_proof_dynamic(ctx, seed1, path, i)
 
-            cw_seed.append(seed_cw)
-            cw_ctrl.append(jnp.stack([ctrl_cw_l, ctrl_cw_r], axis=-1))
-            cw_w.append(w_cw)
-            cw_proof.append(proof_cw)
+            ys = (seed_cw,
+                  jnp.stack([ctrl_cw_l, ctrl_cw_r], axis=-1),
+                  w_cw, proof_cw)
+            return ((seed0, seed1, t0k, t1k, ok), ys)
 
+        init = (keys[:, 0], keys[:, 1],
+                jnp.zeros(num_reports, bool),
+                jnp.ones(num_reports, bool),
+                jnp.ones(num_reports, bool))
+        ((_s0, _s1, _t0, _t1, ok), ys) = jax.lax.scan(
+            body, init,
+            (alphas.T, level_paths, jnp.arange(bits, dtype=jnp.int32)))
+
+        (cw_seed, cw_ctrl, cw_w, cw_proof) = ys
         cws = BatchedCorrectionWords(
-            seed=jnp.stack(cw_seed, axis=1),
-            ctrl=jnp.stack(cw_ctrl, axis=1),
-            w=jnp.stack(cw_w, axis=1),
-            proof=jnp.stack(cw_proof, axis=1),
+            seed=jnp.moveaxis(cw_seed, 0, 1),
+            ctrl=jnp.moveaxis(cw_ctrl, 0, 1),
+            w=jnp.moveaxis(cw_w, 0, 1),
+            proof=jnp.moveaxis(cw_proof, 0, 1),
         )
         return (cws, keys, ok)
 

@@ -1,0 +1,398 @@
+"""Report-chunked incremental heavy hitters: the at-scale execution
+model (PERF.md §4's production plan).
+
+The incremental engine's cross-round carry is O(BITS x width) per
+report — far beyond HBM at the north-star shape (1M reports x 256
+bits).  The protocol is embarrassingly parallel across reports
+(reference loop /root/reference/poc/examples.py:49-71 is per-report;
+aggregation is a plain sum, mastic.py:384-397), so the production
+model streams fixed-size report chunks through each round:
+
+* the full report batch and every chunk's cross-round carry live in
+  HOST memory; the device holds exactly one chunk's state at a time
+  (the steady-state tile bench.py measures);
+* all chunks share one compiled round program (the last chunk is
+  padded with dead lanes, masked out of acceptance and aggregation);
+* each chunk's aggregate share is accumulated on the host, so the
+  collector-facing results are bit-identical to the unchunked runner
+  (tests/test_chunked.py locks this).
+
+Memory accounting (`memory_accounting()`) reports the per-chunk device
+footprint vs the total host footprint — the numbers that justify the
+design at shapes where the unchunked carry cannot exist on one chip.
+"""
+
+import time
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common import vec_add
+from ..metrics import (RoundMetrics, attribute_rejections,
+                       count_round_bytes, count_round_ops)
+from ..backend.mastic_jax import BatchedMastic, ReportBatch
+
+
+class HostReportStore:
+    """A report batch resident in host memory, sliced into fixed-size
+    device chunks (the upload database of a real aggregator; the
+    checkpoint note at SURVEY.md §5 scopes report persistence to the
+    caller — this class is that caller-side store)."""
+
+    def __init__(self, arrays: dict, num_reports: int, chunk_size: int):
+        self.arrays = arrays
+        self.num_reports = num_reports
+        self.chunk_size = chunk_size
+        self.num_chunks = -(-num_reports // chunk_size)
+        self.use_jr = arrays.get("leader_seeds") is not None
+
+    @classmethod
+    def from_batch(cls, batch: ReportBatch,
+                   chunk_size: int) -> "HostReportStore":
+        """Adopt a marshalled batch (device arrays land back on host)."""
+        arrays = {
+            "nonces": np.asarray(batch.nonces),
+            "cws_seed": np.asarray(batch.cws.seed),
+            "cws_ctrl": np.asarray(batch.cws.ctrl),
+            "cws_w": np.asarray(batch.cws.w),
+            "cws_proof": np.asarray(batch.cws.proof),
+            "keys": np.asarray(batch.keys),
+            "leader_proofs": np.asarray(batch.leader_proofs),
+            "helper_seeds": np.asarray(batch.helper_seeds),
+            "leader_seeds": (None if batch.leader_seeds is None
+                             else np.asarray(batch.leader_seeds)),
+            "peer_parts": tuple(
+                None if p is None else np.asarray(p)
+                for p in batch.peer_parts),
+        }
+        return cls(arrays, int(batch.nonces.shape[0]), chunk_size)
+
+    def chunk_bounds(self, i: int) -> tuple[int, int]:
+        lo = i * self.chunk_size
+        return (lo, min(lo + self.chunk_size, self.num_reports))
+
+    def device_chunk(self, i: int) -> tuple[ReportBatch, np.ndarray]:
+        """Chunk i as device arrays, padded to chunk_size with dead
+        lanes (row 0 repeated).  Returns (batch, live mask)."""
+        from ..backend.vidpf_jax import BatchedCorrectionWords
+
+        (lo, hi) = self.chunk_bounds(i)
+        pad = self.chunk_size - (hi - lo)
+
+        def take(x):
+            if x is None:
+                return None
+            sl = x[lo:hi]
+            if pad:
+                sl = np.concatenate(
+                    [sl, np.repeat(sl[:1], pad, axis=0)], axis=0)
+            return jnp.asarray(sl)
+
+        a = self.arrays
+        batch = ReportBatch(
+            nonces=take(a["nonces"]),
+            cws=BatchedCorrectionWords(
+                seed=take(a["cws_seed"]), ctrl=take(a["cws_ctrl"]),
+                w=take(a["cws_w"]), proof=take(a["cws_proof"])),
+            keys=take(a["keys"]),
+            leader_proofs=take(a["leader_proofs"]),
+            helper_seeds=take(a["helper_seeds"]),
+            leader_seeds=take(a["leader_seeds"]),
+            peer_parts=tuple(take(p) for p in a["peer_parts"]))
+        live = np.zeros(self.chunk_size, bool)
+        live[:hi - lo] = True
+        return (batch, live)
+
+    def host_bytes(self) -> int:
+        total = 0
+        for v in self.arrays.values():
+            if isinstance(v, tuple):
+                total += sum(x.nbytes for x in v if x is not None)
+            elif v is not None:
+                total += v.nbytes
+        return total
+
+
+class _ChunkState(NamedTuple):
+    """One chunk's host-resident cross-round state: both aggregators'
+    carries plus the per-report AES round keys (kept so rounds > 0
+    skip the key-schedule recompute)."""
+    carries: list   # [Carry-of-numpy x 2]
+    ext_rk: np.ndarray
+    conv_rk: np.ndarray
+
+
+def _carry_to_host(carry):
+    from ..backend.incremental import Carry
+
+    return Carry(w=np.asarray(carry.w), proof=np.asarray(carry.proof),
+                 seed=np.asarray(carry.seed),
+                 ctrl=np.asarray(carry.ctrl))
+
+
+def _carry_to_device(carry):
+    from ..backend.incremental import Carry
+
+    return Carry(w=jnp.asarray(carry.w), proof=jnp.asarray(carry.proof),
+                 seed=jnp.asarray(carry.seed),
+                 ctrl=jnp.asarray(carry.ctrl))
+
+
+def _carry_bytes(carry) -> int:
+    return sum(np.asarray(x).nbytes for x in carry)
+
+
+class ChunkedIncrementalRunner:
+    """Drives backend/incremental.py chunk by chunk.
+
+    External contract matches _IncrementalRunner (round(),
+    width/fallback/carried_paths/prev_paths, checkpoint arrays), so
+    HeavyHittersRun can swap it in when a chunk size is given.
+    """
+
+    def __init__(self, bm: BatchedMastic, verify_key: bytes, ctx: bytes,
+                 store: HostReportStore, reports: Optional[list] = None,
+                 width: int = 8):
+        from ..backend.incremental import IncrementalMastic
+
+        self.bm = bm
+        self.verify_key = verify_key
+        self.ctx = ctx
+        self.store = store
+        self.reports = reports
+        self.num_reports = store.num_reports
+        self.fallback = np.zeros(self.num_reports, bool)
+        self.width = max(4, width)
+        self.mesh = None  # set via parallel.mesh.shard_incremental_runner
+        self.engine = IncrementalMastic(bm, self.width)
+        self._eval_fn = None
+        self._agg_fn = None
+        self._wc_fns: dict = {}
+        self._rk_fn = jax.jit(lambda n: bm.vidpf.roundkeys(ctx, n))
+        self.chunks = [self._init_chunk(i)
+                       for i in range(store.num_chunks)]
+        self.carried_paths: list = []
+        self.prev_paths = None
+
+    def _init_chunk(self, i: int) -> _ChunkState:
+        (batch, _live) = self.store.device_chunk(i)
+        (ext_rk, conv_rk) = self._rk_fn(batch.nonces)
+        carries = [
+            _carry_to_host(self.engine.init_carry(
+                self.store.chunk_size, batch.keys[:, a], a))
+            for a in range(2)
+        ]
+        return _ChunkState(carries=carries,
+                           ext_rk=np.asarray(ext_rk),
+                           conv_rk=np.asarray(conv_rk))
+
+    # -- program cache (same shapes for every chunk) ---------------
+
+    def _fns(self):
+        if self._eval_fn is None:
+            engine = self.engine
+            (vk, ctx) = (self.verify_key, self.ctx)
+
+            def both(c0, c1, rnd, ext_rk, conv_rk, cws):
+                (c0, proof0, out0, ok0) = engine.agg_round(
+                    0, vk, ctx, c0, rnd, ext_rk, conv_rk, cws)
+                (c1, proof1, out1, ok1) = engine.agg_round(
+                    1, vk, ctx, c1, rnd, ext_rk, conv_rk, cws)
+                accept = jnp.all(proof0 == proof1, axis=-1)
+                return (c0, c1, out0, out1, accept, ok0 & ok1)
+
+            def agg(out0, out1, accept):
+                return (self.bm.aggregate(out0, accept),
+                        self.bm.aggregate(out1, accept))
+
+            self._eval_fn = jax.jit(both, donate_argnums=(0, 1))
+            self._agg_fn = jax.jit(agg)
+        return (self._eval_fn, self._agg_fn)
+
+    def _wc_fn(self, level: int):
+        fn = self._wc_fns.get(level)
+        if fn is None:
+            (bm, vk, ctx) = (self.bm, self.verify_key, self.ctx)
+            fn = jax.jit(lambda b, w0, w1: bm.weight_check_device(
+                vk, ctx, level, b, w0, w1))
+            self._wc_fns[level] = fn
+        return fn
+
+    def _grow(self, width: int) -> None:
+        from ..backend.incremental import Carry, IncrementalMastic
+
+        pad = width - self.width
+        for cs in self.chunks:
+            for a in range(2):
+                c = cs.carries[a]
+                cs.carries[a] = Carry(
+                    w=np.pad(c.w, ((0, 0), (0, 0), (0, pad),
+                                   (0, 0), (0, 0))),
+                    proof=np.pad(c.proof,
+                                 ((0, 0), (0, 0), (0, pad), (0, 0))),
+                    seed=np.pad(c.seed, ((0, 0), (0, pad), (0, 0))),
+                    ctrl=np.pad(c.ctrl, ((0, 0), (0, pad))),
+                )
+        self.width = width
+        self.engine = IncrementalMastic(self.bm, width)
+        self._eval_fn = None
+        self._agg_fn = None
+
+    def _plan(self, prefixes, level):
+        from ..backend.incremental import RoundPlan
+
+        while True:
+            try:
+                return RoundPlan(prefixes, level,
+                                 self.bm.m.vidpf.BITS, self.width,
+                                 self.prev_paths, self.carried_paths)
+            except ValueError as err:
+                if "exceeds padded width" not in str(err):
+                    raise
+                self._grow(self.width * 2)
+
+    # -- one round over every chunk --------------------------------
+
+    def round(self, agg_param,
+              metrics_out: Optional[list] = None) -> list:
+        from .heavy_hitters import splice_rejected
+        from ..backend.incremental import round_inputs
+
+        (level, prefixes, do_weight_check) = agg_param
+        plan = self._plan(prefixes, level)
+        rnd = round_inputs(plan)
+        (eval_fn, agg_fn) = self._fns()
+        rows = len(prefixes) * (1 + self.bm.m.flp.OUTPUT_LEN)
+
+        agg_shares = [[self.bm.m.field(0)] * rows for _ in range(2)]
+        accept_all = np.zeros(self.num_reports, bool)
+        chunk_stats = []
+        evals_per_report = 2 * plan.parent_count * 2  # both parties
+
+        for (i, cs) in enumerate(self.chunks):
+            t0 = time.perf_counter()
+            (batch, live) = self.store.device_chunk(i)
+            (lo, hi) = self.store.chunk_bounds(i)
+            dev_c0 = _carry_to_device(cs.carries[0])
+            dev_c1 = _carry_to_device(cs.carries[1])
+            ext_rk = jnp.asarray(cs.ext_rk)
+            conv_rk = jnp.asarray(cs.conv_rk)
+            if self.mesh is not None:
+                # Chunk upload lands report-sharded across the mesh;
+                # aggregation below is the only cross-chip collective.
+                from ..parallel.mesh import place_reports
+                (batch, dev_c0, dev_c1, ext_rk, conv_rk) = \
+                    place_reports(self.mesh,
+                                  (batch, dev_c0, dev_c1, ext_rk,
+                                   conv_rk))
+            (c0, c1, out0, out1, accept, ok) = eval_fn(
+                dev_c0, dev_c1, rnd, ext_rk, conv_rk, batch.cws)
+            cs.carries[0] = _carry_to_host(c0)
+            cs.carries[1] = _carry_to_host(c1)
+            ok = np.asarray(ok)
+            self.fallback[lo:hi] |= ~ok[:hi - lo]
+
+            accept = np.asarray(accept).copy()
+            if do_weight_check:
+                (wc_checks, wc_ok) = self._wc_fn(level)(
+                    batch, c0.w[:, 0, :2], c1.w[:, 0, :2])
+                self.fallback[lo:hi] |= ~np.asarray(wc_ok)[:hi - lo]
+                wc_accept = np.asarray(wc_checks["weight_check"])
+                if "joint_rand" in wc_checks:
+                    wc_accept = wc_accept & np.asarray(
+                        wc_checks["joint_rand"])
+                accept &= wc_accept
+
+            valid = live.copy()
+            valid[:hi - lo] &= ~self.fallback[lo:hi]
+            accept &= valid
+            (agg0, agg1) = agg_fn(out0, out1, jnp.asarray(accept))
+            for (a, arr) in ((0, agg0), (1, agg1)):
+                agg_shares[a] = vec_add(
+                    agg_shares[a],
+                    self.bm.agg_share_to_host(arr[:rows]))
+            accept_all[lo:hi] = accept[:hi - lo]
+            wall = time.perf_counter() - t0
+            chunk_stats.append({
+                "chunk": i, "reports": hi - lo,
+                "wall_ms": round(wall * 1e3, 2),
+                "node_evals_per_sec": round(
+                    self.store.chunk_size * evals_per_report / wall, 1),
+            })
+
+        self.carried_paths = plan.needed
+        self.prev_paths = plan.needed[level]
+
+        metrics = RoundMetrics(level=level,
+                               frontier_width=len(prefixes),
+                               padded_width=self.width,
+                               reports_total=self.num_reports)
+        attribute_rejections(metrics, accept_all,
+                             device_ok=~self.fallback)
+        count_round_ops(metrics, self.bm.m, self.num_reports,
+                        2 * plan.parent_count,
+                        include_key_setup=(level == 0))
+        count_round_bytes(metrics, self.bm.m, agg_param,
+                          self.num_reports)
+        metrics.extra["chunks"] = chunk_stats
+        metrics.extra["memory"] = self.memory_accounting()
+
+        splice_rejected(self.bm.m, self.verify_key, self.ctx, agg_param,
+                        self.reports, ~self.fallback, accept_all,
+                        agg_shares)
+        metrics.accepted = int(accept_all.sum())
+        metrics.xof_fallbacks = int(self.fallback.sum())
+        metrics.rejected_fallback = int(
+            (self.fallback & ~accept_all).sum())
+        if metrics_out is not None:
+            metrics_out.append(metrics)
+        num = int(accept_all.sum())
+        return self.bm.m.unshard(agg_param, agg_shares, num)
+
+    def memory_accounting(self) -> dict:
+        """Device-vs-host footprint: the chunked design's reason to
+        exist.  Device holds one chunk (2 carries + batch tile); host
+        holds every chunk's carry plus the report store."""
+        carry = 2 * _carry_bytes(self.chunks[0].carries[0])
+        rk = (self.chunks[0].ext_rk.nbytes
+              + self.chunks[0].conv_rk.nbytes)
+        store = self.store
+        tile = 0
+        for v in store.arrays.values():
+            if isinstance(v, tuple):
+                tile += sum(x[:1].nbytes * store.chunk_size
+                            for x in v if x is not None)
+            elif v is not None:
+                tile += v[:1].nbytes * store.chunk_size
+        host = (sum(2 * _carry_bytes(cs.carries[0]) + cs.ext_rk.nbytes
+                    + cs.conv_rk.nbytes for cs in self.chunks)
+                + store.host_bytes())
+        return {
+            "chunk_size": store.chunk_size,
+            "num_chunks": store.num_chunks,
+            "device_bytes_per_chunk": carry + rk + tile,
+            "device_carry_bytes": carry,
+            "host_bytes_total": host,
+        }
+
+    # -- checkpoint hooks (HeavyHittersRun.to_bytes/from_bytes) ----
+
+    def state_arrays(self) -> dict:
+        from ..backend.incremental import carry_to_arrays
+
+        data: dict = {"chunk_size": np.int64(self.store.chunk_size)}
+        for (i, cs) in enumerate(self.chunks):
+            data.update(carry_to_arrays(cs.carries[0], f"k{i}_c0_"))
+            data.update(carry_to_arrays(cs.carries[1], f"k{i}_c1_"))
+        return data
+
+    def load_state(self, arrays, num_chunks: int) -> None:
+        from ..backend.incremental import carry_from_arrays
+
+        for i in range(num_chunks):
+            self.chunks[i].carries[0] = _carry_to_host(
+                carry_from_arrays(arrays, f"k{i}_c0_"))
+            self.chunks[i].carries[1] = _carry_to_host(
+                carry_from_arrays(arrays, f"k{i}_c1_"))

@@ -192,7 +192,7 @@ def compute_heavy_hitters(mastic: Mastic, ctx: bytes, thresholds: dict,
     return run.result()
 
 
-_CKPT_VERSION = 1
+_CKPT_VERSION = 2  # v2 added the chunk_size meta field (0 = unchunked)
 
 
 def _ckpt_binding(verify_key: bytes, ctx: bytes,
@@ -235,8 +235,13 @@ class HeavyHittersRun:
     """
 
     def __init__(self, mastic: Mastic, ctx: bytes, thresholds: dict,
-                 reports: list, verify_key: Optional[bytes] = None,
-                 incremental: bool = True):
+                 reports: Optional[list],
+                 verify_key: Optional[bytes] = None,
+                 incremental: bool = True,
+                 chunk_size: Optional[int] = None,
+                 store=None, mesh=None):
+        from .chunked import ChunkedIncrementalRunner, HostReportStore
+
         if verify_key is None:
             verify_key = gen_rand(mastic.VERIFY_KEY_SIZE)
         self.mastic = mastic
@@ -245,11 +250,34 @@ class HeavyHittersRun:
         self.reports = reports
         self.verify_key = verify_key
         self.bm = BatchedMastic(mastic)
-        self.batch = self.bm.marshal_reports(reports)
-        self.runner = (
-            _IncrementalRunner(self.bm, verify_key, ctx, self.batch,
-                               reports)
-            if incremental else None)
+        if chunk_size is not None or store is not None:
+            # At-scale path: reports stream through the device chunk
+            # by chunk; the device never holds the whole batch (the
+            # scalar `reports` list is optional — only the rejection
+            # fallback needs it).
+            if store is None:
+                store = HostReportStore.from_batch(
+                    self.bm.marshal_reports(reports), chunk_size)
+            self.store = store
+            self.batch = None
+            self.num_reports = store.num_reports
+            self.runner = ChunkedIncrementalRunner(
+                self.bm, verify_key, ctx, store, reports)
+        else:
+            self.store = None
+            self.batch = self.bm.marshal_reports(reports)
+            self.num_reports = len(reports)
+            self.runner = (
+                _IncrementalRunner(self.bm, verify_key, ctx, self.batch,
+                                   reports)
+                if incremental else None)
+        if mesh is not None:
+            if self.runner is None:
+                raise ValueError(
+                    "mesh sharding requires the incremental runner "
+                    "(incremental=True or a chunk_size/store)")
+            from ..parallel.mesh import shard_incremental_runner
+            shard_incremental_runner(self.runner, mesh)
         self.level = 0
         self.prefixes: list = [(False,), (True,)]
         self.prev_agg_params: list = []
@@ -321,12 +349,15 @@ class HeavyHittersRun:
         import io
 
         from ..backend.incremental import carry_to_arrays
+        from .chunked import ChunkedIncrementalRunner
 
+        chunked = isinstance(self.runner, ChunkedIncrementalRunner)
         data = {
             "meta": np.array(
                 [_CKPT_VERSION, self.level, int(self.done),
                  0 if self.runner is None else 1,
-                 self.mastic.vidpf.BITS, len(self.reports)], np.int64),
+                 self.mastic.vidpf.BITS, self.num_reports,
+                 self.store.chunk_size if chunked else 0], np.int64),
             "binding": _ckpt_binding(self.verify_key, self.ctx,
                                      self.thresholds),
             "prefixes": _paths_to_array(self.prefixes),
@@ -339,7 +370,11 @@ class HeavyHittersRun:
         if self.prev_agg_params:
             data["last_prefixes"] = _paths_to_array(
                 self.prev_agg_params[-1][1])
-        if self.runner is not None:
+        if chunked:
+            data["width"] = np.int64(self.runner.width)
+            data["fallback"] = self.runner.fallback
+            data.update(self.runner.state_arrays())
+        elif self.runner is not None:
             data["width"] = np.int64(self.runner.width)
             data["fallback"] = self.runner.fallback
             data.update(carry_to_arrays(self.runner.carries[0], "c0_"))
@@ -350,22 +385,38 @@ class HeavyHittersRun:
 
     @classmethod
     def from_bytes(cls, mastic: Mastic, ctx: bytes, thresholds: dict,
-                   reports: list, verify_key: bytes,
-                   data: bytes) -> "HeavyHittersRun":
-        """Restore a checkpointed run over the same report store."""
+                   reports: Optional[list], verify_key: bytes,
+                   data: bytes, store=None,
+                   mesh=None) -> "HeavyHittersRun":
+        """Restore a checkpointed run over the same report store (a
+        chunked run may pass `store` instead of scalar reports)."""
         import io
 
         from ..backend.incremental import (carry_from_arrays,
                                            needed_paths)
+        from .chunked import ChunkedIncrementalRunner
 
         arrays = np.load(io.BytesIO(data), allow_pickle=False)
-        (version, level, done, incremental, bits, num_reports) = \
-            [int(x) for x in arrays["meta"]]
-        if version != _CKPT_VERSION:
+        meta = [int(x) for x in arrays["meta"]]
+        version = meta[0]
+        if version == 1:
+            (_, level, done, incremental, bits, num_reports) = meta
+            chunk_size = 0
+        elif version == _CKPT_VERSION:
+            (_, level, done, incremental, bits, num_reports,
+             chunk_size) = meta
+        else:
             raise ValueError(f"unknown checkpoint version {version}")
-        if bits != mastic.vidpf.BITS or num_reports != len(reports):
+        restored_n = (store.num_reports if store is not None
+                      else len(reports))
+        if bits != mastic.vidpf.BITS or num_reports != restored_n:
             raise ValueError("checkpoint does not match this "
                              "instantiation / report store")
+        if chunk_size and store is not None \
+                and store.chunk_size != chunk_size:
+            raise ValueError(
+                f"checkpoint was taken with chunk_size={chunk_size}, "
+                f"store has {store.chunk_size}")
         if not np.array_equal(np.asarray(arrays["binding"]),
                               _ckpt_binding(verify_key, ctx,
                                             thresholds)):
@@ -373,7 +424,9 @@ class HeavyHittersRun:
                              "verify_key / ctx / thresholds")
 
         run = cls(mastic, ctx, thresholds, reports,
-                  verify_key=verify_key, incremental=bool(incremental))
+                  verify_key=verify_key, incremental=bool(incremental),
+                  chunk_size=chunk_size if chunk_size else None,
+                  store=store, mesh=mesh)
         run.level = level
         run.done = bool(done)
         run.prefixes = _paths_from_array(arrays["prefixes"])
@@ -392,7 +445,23 @@ class HeavyHittersRun:
              wc)
             for (i, (lvl, wc)) in enumerate(zip(prev_levels, prev_wc))
         ]
-        if run.runner is not None and prev_levels:
+        if isinstance(run.runner, ChunkedIncrementalRunner) \
+                and prev_levels:
+            from ..backend.incremental import IncrementalMastic
+
+            runner = run.runner
+            width = int(arrays["width"])
+            if width != runner.width:
+                runner.width = width
+                runner.engine = IncrementalMastic(runner.bm, width)
+                runner._eval_fn = None
+                runner._agg_fn = None
+            runner.fallback = np.asarray(arrays["fallback"], bool)
+            runner.load_state(arrays, runner.store.num_chunks)
+            carried = needed_paths(last_prefixes, prev_levels[-1])
+            runner.carried_paths = carried
+            runner.prev_paths = carried[prev_levels[-1]]
+        elif run.runner is not None and prev_levels:
             from ..backend.incremental import IncrementalMastic
 
             runner = run.runner
@@ -411,6 +480,10 @@ class HeavyHittersRun:
                 carry_from_arrays(arrays, "c0_"),
                 carry_from_arrays(arrays, "c1_"),
             ]
+            if runner.mesh is not None:
+                from ..parallel.mesh import place_reports
+                runner.carries = [place_reports(runner.mesh, c)
+                                  for c in runner.carries]
             carried = needed_paths(last_prefixes, prev_levels[-1])
             runner.carried_paths = carried
             runner.prev_paths = carried[prev_levels[-1]]
@@ -441,6 +514,7 @@ class _IncrementalRunner:
         # recomputed through the scalar layer each round instead.
         self.fallback = np.zeros(self.num_reports, bool)
         self.width = max(4, width)
+        self.mesh = None  # set via parallel.mesh.shard_incremental_runner
         self.engine = IncrementalMastic(bm, self.width)
         (self.ext_rk, self.conv_rk) = jax.jit(
             lambda n: bm.vidpf.roundkeys(ctx, n))(batch.nonces)
@@ -470,6 +544,10 @@ class _IncrementalRunner:
             )
             for c in self.carries
         ]
+        if self.mesh is not None:
+            from ..parallel.mesh import place_reports
+            self.carries = [place_reports(self.mesh, c)
+                            for c in self.carries]
         self.width = width
         self.engine = IncrementalMastic(self.bm, width)
         self._eval_fn = None

@@ -115,6 +115,54 @@ def sharded_round_fn(bm: BatchedMastic, mesh: Mesh, verify_key: bytes,
                                       NamedSharding(mesh, P("reports"))))
 
 
+def place_reports(mesh: Mesh, tree):
+    """Place every array in a pytree with its leading (report) axis
+    sharded over the mesh's "reports" axis, other axes replicated.
+    None leaves pass through (optional batch fields)."""
+
+    def put(x):
+        if x is None:
+            return None
+        spec = ["reports"] + [None] * (x.ndim - 1)
+        return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+
+    return jax.tree.map(put, tree, is_leaf=lambda x: x is None)
+
+
+def shard_incremental_runner(runner, mesh: Mesh) -> None:
+    """Make an incremental runner mesh-aware (SURVEY.md §7 step 7 for
+    the production execution model): both aggregators' carries, the
+    AES round keys and the correction-word arrays are sharded on the
+    report axis, so every per-report op in agg_round runs purely
+    locally and the only cross-chip traffic is the masked
+    aggregation's sum over the sharded axis — which GSPMD lowers to an
+    all-reduce (psum over ICI), exactly the reference's agg_update
+    fold (mastic.py:384-397) distributed.
+
+    Works for both _IncrementalRunner (resident batch) and
+    ChunkedIncrementalRunner (per-chunk placement at upload time via
+    runner.mesh)."""
+    n_rep = mesh.shape["reports"]
+    store = getattr(runner, "store", None)
+    per_device = (store.chunk_size if store is not None
+                  else runner.num_reports)
+    if per_device % n_rep != 0:
+        what = "chunk_size" if store is not None else "report count"
+        raise ValueError(
+            f"{what} {per_device} must be divisible by the mesh's "
+            f"reports axis ({n_rep}) to shard evenly")
+    runner.mesh = mesh
+    if getattr(runner, "carries", None) is not None:
+        runner.carries = [place_reports(mesh, c)
+                          for c in runner.carries]
+    if getattr(runner, "batch", None) is not None:
+        runner.batch = place_reports(mesh, runner.batch)
+    for name in ("ext_rk", "conv_rk"):
+        if getattr(runner, name, None) is not None:
+            setattr(runner, name,
+                    place_reports(mesh, getattr(runner, name)))
+
+
 def sharded_gen_fn(bm: BatchedMastic, mesh: Mesh, ctx: bytes):
     """Jit batched client-side VIDPF key generation with reports
     sharded across the mesh (the client fleet axis)."""

@@ -115,6 +115,52 @@ def test_sharded_weight_check_round():
                       for p in range(2)]
 
 
+@pytest.mark.parametrize("chunked", [False, True],
+                         ids=["resident", "chunked"])
+def test_incremental_heavy_hitters_sharded(chunked):
+    """The production execution model (incremental engine) over the
+    mesh: a full multi-level heavy-hitters run with report-sharded
+    carries must be bit-identical to the single-device run — the claim
+    PERF.md's 8-chip projection rests on."""
+    from mastic_tpu.common import gen_rand
+    from mastic_tpu.drivers.heavy_hitters import (
+        HeavyHittersRun, get_reports_from_measurements)
+
+    mastic = MasticCount(3)
+    meas = [((bool(v >> 2 & 1), bool(v >> 1 & 1), bool(v & 1)), True)
+            for v in [0, 0, 0, 5, 5, 5, 3, 1,
+                      0, 5, 6, 6, 0, 5, 2, 7]]
+    reports = get_reports_from_measurements(mastic, CTX, meas)
+    # Tamper one report: the reject verdict must also match across
+    # the sharded/unsharded pair.
+    (nonce, ps, shares) = reports[6]
+    (key, proof, seed, part) = shares[0]
+    reports[6] = (nonce, ps, [
+        (bytes([key[0] ^ 1]) + key[1:], proof, seed, part), shares[1]])
+    vk = gen_rand(mastic.VERIFY_KEY_SIZE)
+    thresholds = {"default": 3}
+    mesh = make_mesh(8, nodes_axis=1)
+    # The chunk is the device tile: it must shard evenly (16 reports
+    # -> two chunks of 8 over the 8-device reports axis).
+    kwargs = {"chunk_size": 8} if chunked else {}
+
+    base = HeavyHittersRun(mastic, CTX, thresholds, reports,
+                           verify_key=vk, **kwargs)
+    meshed = HeavyHittersRun(mastic, CTX, thresholds, reports,
+                             verify_key=vk, mesh=mesh, **kwargs)
+    assert meshed.runner.mesh is mesh
+    while True:
+        (a, b) = (base.step(), meshed.step())
+        assert a == b
+        (m0, m1) = (base.metrics[-1], meshed.metrics[-1])
+        assert m0.accepted == m1.accepted
+        assert m0.rejected_eval_proof == m1.rejected_eval_proof
+        if not a:
+            break
+    assert base.result() == meshed.result()
+    assert base.result()  # honest hitters survive
+
+
 def _round(bm, agg_param, nonces, cws, k0, k1):
     p0 = bm.prep(0, VK, CTX, agg_param, nonces, cws, k0)
     p1 = bm.prep(1, VK, CTX, agg_param, nonces, cws, k1)
