@@ -144,12 +144,16 @@ def _carry_bytes(carry) -> int:
     return sum(np.asarray(x).nbytes for x in carry)
 
 
-class ChunkedIncrementalRunner:
+from .heavy_hitters import RoundPrograms
+
+
+class ChunkedIncrementalRunner(RoundPrograms):
     """Drives backend/incremental.py chunk by chunk.
 
     External contract matches _IncrementalRunner (round(),
     width/fallback/carried_paths/prev_paths, checkpoint arrays), so
-    HeavyHittersRun can swap it in when a chunk size is given.
+    HeavyHittersRun can swap it in when a chunk size is given; the
+    jitted round programs are shared via RoundPrograms.
     """
 
     def __init__(self, bm: BatchedMastic, verify_key: bytes, ctx: bytes,
@@ -177,48 +181,48 @@ class ChunkedIncrementalRunner:
         self.prev_paths = None
 
     def _init_chunk(self, i: int) -> _ChunkState:
-        (batch, _live) = self.store.device_chunk(i)
-        (ext_rk, conv_rk) = self._rk_fn(batch.nonces)
-        carries = [
-            _carry_to_host(self.engine.init_carry(
-                self.store.chunk_size, batch.keys[:, a], a))
-            for a in range(2)
-        ]
+        """Initial carries and AES round keys for chunk i — built from
+        cheap host slices (only the nonces cross to the device for the
+        key schedules; uploading the whole chunk batch here would
+        stream the full O(BITS) report store through the device,
+        exactly the startup cost the chunked design avoids)."""
+        from ..backend.incremental import Carry
+
+        (lo, hi) = self.store.chunk_bounds(i)
+        size = self.store.chunk_size
+        pad = size - (hi - lo)
+
+        def take(x):
+            sl = x[lo:hi]
+            if pad:
+                sl = np.concatenate(
+                    [sl, np.repeat(sl[:1], pad, axis=0)], axis=0)
+            return sl
+
+        nonces = take(self.store.arrays["nonces"])
+        keys = take(self.store.arrays["keys"])
+        (ext_rk, conv_rk) = self._rk_fn(jnp.asarray(nonces))
+
+        vid = self.bm.m.vidpf
+        bits = vid.BITS
+        seed = np.zeros((size, self.width, 16), np.uint8)
+        ctrl = np.zeros((size, self.width), bool)
+        carries = []
+        for a in range(2):
+            s = seed.copy()
+            s[:, 0, :] = keys[:, a]
+            c = ctrl.copy()
+            c[:, 0] = bool(a)
+            carries.append(Carry(
+                w=np.zeros((size, bits, self.width,
+                            vid.VALUE_LEN, self.bm.spec.num_limbs),
+                           np.uint32),
+                proof=np.zeros((size, bits, self.width, 32),
+                               np.uint8),
+                seed=s, ctrl=c))
         return _ChunkState(carries=carries,
                            ext_rk=np.asarray(ext_rk),
                            conv_rk=np.asarray(conv_rk))
-
-    # -- program cache (same shapes for every chunk) ---------------
-
-    def _fns(self):
-        if self._eval_fn is None:
-            engine = self.engine
-            (vk, ctx) = (self.verify_key, self.ctx)
-
-            def both(c0, c1, rnd, ext_rk, conv_rk, cws):
-                (c0, proof0, out0, ok0) = engine.agg_round(
-                    0, vk, ctx, c0, rnd, ext_rk, conv_rk, cws)
-                (c1, proof1, out1, ok1) = engine.agg_round(
-                    1, vk, ctx, c1, rnd, ext_rk, conv_rk, cws)
-                accept = jnp.all(proof0 == proof1, axis=-1)
-                return (c0, c1, out0, out1, accept, ok0 & ok1)
-
-            def agg(out0, out1, accept):
-                return (self.bm.aggregate(out0, accept),
-                        self.bm.aggregate(out1, accept))
-
-            self._eval_fn = jax.jit(both, donate_argnums=(0, 1))
-            self._agg_fn = jax.jit(agg)
-        return (self._eval_fn, self._agg_fn)
-
-    def _wc_fn(self, level: int):
-        fn = self._wc_fns.get(level)
-        if fn is None:
-            (bm, vk, ctx) = (self.bm, self.verify_key, self.ctx)
-            fn = jax.jit(lambda b, w0, w1: bm.weight_check_device(
-                vk, ctx, level, b, w0, w1))
-            self._wc_fns[level] = fn
-        return fn
 
     def _grow(self, width: int) -> None:
         from ..backend.incremental import Carry, IncrementalMastic
@@ -240,19 +244,6 @@ class ChunkedIncrementalRunner:
         self._eval_fn = None
         self._agg_fn = None
 
-    def _plan(self, prefixes, level):
-        from ..backend.incremental import RoundPlan
-
-        while True:
-            try:
-                return RoundPlan(prefixes, level,
-                                 self.bm.m.vidpf.BITS, self.width,
-                                 self.prev_paths, self.carried_paths)
-            except ValueError as err:
-                if "exceeds padded width" not in str(err):
-                    raise
-                self._grow(self.width * 2)
-
     # -- one round over every chunk --------------------------------
 
     def round(self, agg_param,
@@ -268,6 +259,12 @@ class ChunkedIncrementalRunner:
 
         agg_shares = [[self.bm.m.field(0)] * rows for _ in range(2)]
         accept_all = np.zeros(self.num_reports, bool)
+        # Per-check masks across chunks, so rejection attribution
+        # matches the resident runner's (first-failing-check order).
+        eval_ok_all = np.zeros(self.num_reports, bool)
+        wc_ok_all = (np.zeros(self.num_reports, bool)
+                     if do_weight_check else None)
+        jr_ok_all: Optional[np.ndarray] = None
         chunk_stats = []
         evals_per_report = 2 * plan.parent_count * 2  # both parties
 
@@ -295,14 +292,19 @@ class ChunkedIncrementalRunner:
             self.fallback[lo:hi] |= ~ok[:hi - lo]
 
             accept = np.asarray(accept).copy()
+            eval_ok_all[lo:hi] = accept[:hi - lo]
             if do_weight_check:
                 (wc_checks, wc_ok) = self._wc_fn(level)(
                     batch, c0.w[:, 0, :2], c1.w[:, 0, :2])
                 self.fallback[lo:hi] |= ~np.asarray(wc_ok)[:hi - lo]
                 wc_accept = np.asarray(wc_checks["weight_check"])
+                wc_ok_all[lo:hi] = wc_accept[:hi - lo]
                 if "joint_rand" in wc_checks:
-                    wc_accept = wc_accept & np.asarray(
-                        wc_checks["joint_rand"])
+                    jr = np.asarray(wc_checks["joint_rand"])
+                    if jr_ok_all is None:
+                        jr_ok_all = np.zeros(self.num_reports, bool)
+                    jr_ok_all[lo:hi] = jr[:hi - lo]
+                    wc_accept = wc_accept & jr
                 accept &= wc_accept
 
             valid = live.copy()
@@ -329,8 +331,8 @@ class ChunkedIncrementalRunner:
                                frontier_width=len(prefixes),
                                padded_width=self.width,
                                reports_total=self.num_reports)
-        attribute_rejections(metrics, accept_all,
-                             device_ok=~self.fallback)
+        attribute_rejections(metrics, eval_ok_all, wc_ok_all,
+                             jr_ok_all, device_ok=~self.fallback)
         count_round_ops(metrics, self.bm.m, self.num_reports,
                         2 * plan.parent_count,
                         include_key_setup=(level == 0))

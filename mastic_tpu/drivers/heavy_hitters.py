@@ -490,7 +490,63 @@ class HeavyHittersRun:
         return run
 
 
-class _IncrementalRunner:
+class RoundPrograms:
+    """Shared jitted-program cache for the incremental runners.
+
+    The resident (_IncrementalRunner) and chunked
+    (drivers/chunked.ChunkedIncrementalRunner) runners execute the
+    identical round program — one definition keeps their semantics
+    locked together.  Subclasses provide bm / verify_key / ctx /
+    engine / width / prev_paths / carried_paths and a _grow(width)."""
+
+    def _fns(self):
+        if self._eval_fn is None:
+            engine = self.engine
+            (vk, ctx) = (self.verify_key, self.ctx)
+
+            def both(c0, c1, rnd, ext_rk, conv_rk, cws):
+                (c0, proof0, out0, ok0) = engine.agg_round(
+                    0, vk, ctx, c0, rnd, ext_rk, conv_rk, cws)
+                (c1, proof1, out1, ok1) = engine.agg_round(
+                    1, vk, ctx, c1, rnd, ext_rk, conv_rk, cws)
+                accept = jnp.all(proof0 == proof1, axis=-1)
+                return (c0, c1, out0, out1, accept, ok0 & ok1)
+
+            def agg(out0, out1, accept):
+                return (self.bm.aggregate(out0, accept),
+                        self.bm.aggregate(out1, accept))
+
+            # Carries are donated: both runners replace them with the
+            # outputs (resident keeps them resident; chunked re-uploads
+            # fresh buffers every chunk).
+            self._eval_fn = jax.jit(both, donate_argnums=(0, 1))
+            self._agg_fn = jax.jit(agg)
+        return (self._eval_fn, self._agg_fn)
+
+    def _wc_fn(self, level: int):
+        fn = self._wc_fns.get(level)
+        if fn is None:
+            (bm, vk, ctx) = (self.bm, self.verify_key, self.ctx)
+            fn = jax.jit(lambda b, w0, w1: bm.weight_check_device(
+                vk, ctx, level, b, w0, w1))
+            self._wc_fns[level] = fn
+        return fn
+
+    def _plan(self, prefixes, level):
+        from ..backend.incremental import RoundPlan
+
+        while True:
+            try:
+                return RoundPlan(prefixes, level,
+                                 self.bm.m.vidpf.BITS, self.width,
+                                 self.prev_paths, self.carried_paths)
+            except ValueError as err:
+                if "exceeds padded width" not in str(err):
+                    raise
+                self._grow(self.width * 2)
+
+
+class _IncrementalRunner(RoundPrograms):
     """Drives backend/incremental.py across the collector loop: keeps
     both aggregators' carries, grows the padded width on demand
     (recompiling at most log2(max_width) times), and folds the
@@ -552,49 +608,6 @@ class _IncrementalRunner:
         self.engine = IncrementalMastic(self.bm, width)
         self._eval_fn = None
         self._agg_fn = None
-
-    def _plan(self, prefixes, level):
-        from ..backend.incremental import RoundPlan
-
-        while True:
-            try:
-                return RoundPlan(prefixes, level,
-                                 self.bm.m.vidpf.BITS, self.width,
-                                 self.prev_paths, self.carried_paths)
-            except ValueError as err:
-                if "exceeds padded width" not in str(err):
-                    raise
-                self._grow(self.width * 2)
-
-    def _fns(self):
-        if self._eval_fn is None:
-            engine = self.engine
-            (vk, ctx) = (self.verify_key, self.ctx)
-
-            def both(c0, c1, rnd, ext_rk, conv_rk, cws):
-                (c0, proof0, out0, ok0) = engine.agg_round(
-                    0, vk, ctx, c0, rnd, ext_rk, conv_rk, cws)
-                (c1, proof1, out1, ok1) = engine.agg_round(
-                    1, vk, ctx, c1, rnd, ext_rk, conv_rk, cws)
-                accept = jnp.all(proof0 == proof1, axis=-1)
-                return (c0, c1, out0, out1, accept, ok0 & ok1)
-
-            def agg(out0, out1, accept):
-                return (self.bm.aggregate(out0, accept),
-                        self.bm.aggregate(out1, accept))
-
-            self._eval_fn = jax.jit(both)
-            self._agg_fn = jax.jit(agg)
-        return (self._eval_fn, self._agg_fn)
-
-    def _wc_fn(self, level: int):
-        fn = self._wc_fns.get(level)
-        if fn is None:
-            (bm, vk, ctx) = (self.bm, self.verify_key, self.ctx)
-            fn = jax.jit(lambda b, w0, w1: bm.weight_check_device(
-                vk, ctx, level, b, w0, w1))
-            self._wc_fns[level] = fn
-        return fn
 
     def round(self, agg_param,
               metrics_out: Optional[list] = None) -> list:

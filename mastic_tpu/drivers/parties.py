@@ -36,7 +36,7 @@ from typing import Optional
 import numpy as np
 
 from .. import mastic as mastic_mod
-from ..mastic import Mastic, ReportRejected
+from ..mastic import Mastic
 from .. import wire
 
 
@@ -65,6 +65,7 @@ class AggregatorParty:
         self.reports: list = []
         self.arrays: Optional[dict] = None
         self._prep = None
+        self._resolve_fns: dict = {}
 
     # -- upload channel --------------------------------------------
 
@@ -144,39 +145,90 @@ class AggregatorParty:
 
     def resolve(self, agg_param, peer_blob: bytes) -> tuple:
         """Leader side of prep_shares_to_prep over the report batch:
-        returns (accept bitmap bytes, prep-msg blob)."""
-        (_level, _prefixes, _wc) = agg_param
+        returns (accept bitmap bytes, prep-msg blob).
+
+        Vectorized over the report axis (scalar semantics:
+        mastic.py prep_shares_to_prep + the leader's own joint-rand
+        confirmation): eval-proof equality, the FLP decide over the
+        summed verifier shares (the batched decide kernel), and the
+        joint-rand seed derivation all run as single batched ops.  A
+        verifier element outside the field (possible only from a
+        misbehaving helper) rejects that report instead of aborting
+        the batch."""
+        import jax.numpy as jnp
+
+        (_level, _prefixes, do_wc) = agg_param
         size = wire.prep_share_size(self.m, agg_param)
-        own_blob = self._encode_prep(agg_param, self._prep)
         num = len(self.reports)
-        accept = np.zeros(num, bool)
-        use_jr = (self.m.flp.JOINT_RAND_LEN > 0 and agg_param[2])
-        jr_seed = (None if self._prep.joint_rand_seed is None
-                   else np.asarray(self._prep.joint_rand_seed))
-        msgs = []
-        for r in range(num):
-            own = wire.decode_prep_share(
-                self.m, agg_param, own_blob[r * size:(r + 1) * size])
-            peer = wire.decode_prep_share(
-                self.m, agg_param, peer_blob[r * size:(r + 1) * size])
-            try:
-                prep_msg = self.m.prep_shares_to_prep(
-                    self.ctx, agg_param, [own, peer])
-            except ReportRejected:
-                msgs.append(b"")
-                continue
-            # The leader's own joint-rand confirmation (prep_next
-            # semantics) — the helper runs the same check in confirm().
-            if use_jr:
-                assert jr_seed is not None
-                if prep_msg != jr_seed[r].tobytes():
-                    msgs.append(b"")
-                    continue
-            accept[r] = True
-            msgs.append(prep_msg or b"")
+        p = self._prep
+        peer = np.frombuffer(peer_blob, np.uint8).reshape(num, size)
+        use_jr = (self.m.flp.JOINT_RAND_LEN > 0 and do_wc)
+        fn = self._resolve_fn(do_wc, use_jr, num, size)
+        if do_wc:
+            (accept, prep_msgs) = fn(
+                jnp.asarray(peer), p.eval_proof, p.verifier,
+                p.joint_rand_part, p.joint_rand_seed)
+        else:
+            (accept, prep_msgs) = fn(jnp.asarray(peer), p.eval_proof)
+        accept = np.asarray(accept)
+        prep_msgs = (np.asarray(prep_msgs) if prep_msgs is not None
+                     else None)
+
         bitmap = np.packbits(accept, bitorder="little").tobytes()
-        blob = b"".join(wire.frame(m) for m in msgs)
+        blob = b"".join(
+            wire.frame(prep_msgs[r].tobytes()
+                       if accept[r] and prep_msgs is not None else b"")
+            for r in range(num))
         return (accept, bitmap + blob)
+
+    def _resolve_fn(self, do_wc: bool, use_jr: bool, num: int,
+                    size: int):
+        """One jitted program for the whole batched exchange (eager
+        dispatch of the Keccak/NTT kernels at 10k reports costs more
+        than the math)."""
+        import jax
+        import jax.numpy as jnp
+
+        key = (do_wc, use_jr, num, size)
+        fn = self._resolve_fns.get(key)
+        if fn is not None:
+            return fn
+        (bm, ctx, elem) = (self.bm, self.ctx, self.m.field.ENCODED_SIZE)
+
+        if not do_wc:
+            def fn(peer, eval_proof):
+                return (jnp.all(eval_proof == peer[:, :32], axis=-1),
+                        None)
+        else:
+            def fn(peer, eval_proof, verifier_own, jr_part_own,
+                   jr_seed_own):
+                accept = jnp.all(eval_proof == peer[:, :32], axis=-1)
+                off = 32
+                if use_jr:
+                    part1 = peer[:, off:off + 32]
+                    off += 32
+                ver_bytes = peer[:, off:]
+                vlen = ver_bytes.shape[1] // elem
+                (ver1, in_range) = bm.spec.limbs_from_le_bytes(
+                    ver_bytes.reshape(num, vlen, elem))
+                verifier = bm.spec.add(verifier_own, ver1)
+                accept &= bm.bflp.decide(verifier)
+                accept &= jnp.all(in_range, axis=-1)
+                prep_msgs = None
+                if use_jr:
+                    # prep msg = joint-rand seed from [leader, helper]
+                    # parts; the leader's confirmation compares it to
+                    # its own predicted seed (prep_next semantics —
+                    # the helper runs the same check in confirm()).
+                    prep_msgs = bm.joint_rand_seed(ctx, jr_part_own,
+                                                   part1)
+                    accept &= jnp.all(prep_msgs == jr_seed_own,
+                                      axis=-1)
+                return (accept, prep_msgs)
+
+        fn = jax.jit(fn)
+        self._resolve_fns[key] = fn
+        return fn
 
     def confirm(self, agg_param, resolution: bytes) -> np.ndarray:
         """Helper side: parse the leader's bitmap + prep msgs, run the

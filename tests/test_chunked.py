@@ -77,10 +77,17 @@ def _tampered_reports(m):
     meas = [((bool(v >> 2 & 1), bool(v >> 1 & 1), bool(v & 1)), True)
             for v in [0, 0, 0, 5, 5, 5, 3, 1, 6, 6]]
     reports = get_reports_from_measurements(m, CTX, meas)
+    # Report 4: VIDPF key tamper -> fails the eval-proof check.
     (nonce, ps, shares) = reports[4]
     (key, proof, seed, part) = shares[0]
     reports[4] = (nonce, ps, [
         (bytes([key[0] ^ 1]) + key[1:], proof, seed, part), shares[1]])
+    # Report 7: FLP proof-share tamper -> passes the eval proof,
+    # fails the weight check (attribution must survive chunking).
+    (nonce, ps, shares) = reports[7]
+    (key, proof, seed, part) = shares[0]
+    bad_proof = [proof[0] + m.field(1)] + proof[1:]
+    reports[7] = (nonce, ps, [(key, bad_proof, seed, part), shares[1]])
     return reports
 
 
@@ -101,9 +108,14 @@ def test_chunked_matches_unchunked() -> None:
         for (m0, m1) in zip(runs[0].metrics, runs[1].metrics):
             assert m0.accepted == m1.accepted
             assert m0.rejected_eval_proof == m1.rejected_eval_proof
+            assert m0.rejected_weight_check == m1.rejected_weight_check
+            assert m0.rejected_joint_rand == m1.rejected_joint_rand
             assert m0.node_evals == m1.node_evals
         if not more[0]:
             break
+    # Level 0 attributes one reject to each check, in both runners.
+    assert runs[0].metrics[0].rejected_eval_proof == 1
+    assert runs[0].metrics[0].rejected_weight_check == 1
     assert runs[0].result() == runs[1].result()
     assert runs[1].result()  # nonempty: the honest hitters survive
 
