@@ -223,21 +223,27 @@ class IncrementalMastic:
         self.width = width
         self.bits = bm.m.vidpf.BITS
 
-    def init_carry(self, num_reports: int, keys: jax.Array,
-                   agg_id: int) -> Carry:
-        """Pre-round-0 carry: the frontier is the root key."""
+    def init_carry(self, num_reports: int, keys,
+                   agg_id: int, host: bool = False) -> Carry:
+        """Pre-round-0 carry: the frontier is the root key.  With
+        `host`, the arrays stay numpy (the chunked runner keeps every
+        chunk's carry in host memory); either way this is the single
+        definition of the carry layout."""
         vid = self.bm.vidpf
         spec = self.bm.spec
-        seed = jnp.zeros((num_reports, self.width, KEY_SIZE), _U8)
-        seed = seed.at[:, 0, :].set(keys)
-        ctrl = jnp.zeros((num_reports, self.width), bool)
-        ctrl = ctrl.at[:, 0].set(bool(agg_id))
-        return Carry(
-            w=jnp.zeros((num_reports, self.bits, self.width,
-                         vid.VALUE_LEN, spec.num_limbs), jnp.uint32),
-            proof=jnp.zeros((num_reports, self.bits, self.width,
-                             PROOF_SIZE), _U8),
+        seed = np.zeros((num_reports, self.width, KEY_SIZE), np.uint8)
+        seed[:, 0, :] = np.asarray(keys)
+        ctrl = np.zeros((num_reports, self.width), bool)
+        ctrl[:, 0] = bool(agg_id)
+        carry = Carry(
+            w=np.zeros((num_reports, self.bits, self.width,
+                        vid.VALUE_LEN, spec.num_limbs), np.uint32),
+            proof=np.zeros((num_reports, self.bits, self.width,
+                            PROOF_SIZE), np.uint8),
             seed=seed, ctrl=ctrl)
+        if host:
+            return carry
+        return Carry(*(jnp.asarray(x) for x in carry))
 
     # -- one aggregator's round (jittable) -------------------------
 

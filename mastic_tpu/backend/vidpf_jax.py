@@ -33,9 +33,9 @@ from ..ops.field_jax import FieldSpec, spec_for
 from ..ops.keccak_jax import turbo_shake128_dynamic
 from ..vidpf import PROOF_SIZE, CorrectionWord
 from .schedule import LevelSchedule
-from .xof_jax import (build_msg, fixed_key_blocks,
-                      fixed_key_blocks_planes, fixed_key_schedule,
-                      sample_vec, ts_prefix, turboshake_xof)
+from .xof_jax import (fixed_key_blocks, fixed_key_blocks_planes,
+                      fixed_key_schedule, sample_vec, ts_prefix,
+                      turboshake_xof)
 
 _U8 = jnp.uint8
 

@@ -73,22 +73,29 @@ class HostReportStore:
         lo = i * self.chunk_size
         return (lo, min(lo + self.chunk_size, self.num_reports))
 
+    def host_slice(self, x: np.ndarray, i: int) -> np.ndarray:
+        """Chunk i of a per-report host array, padded to chunk_size
+        with dead lanes (row 0 repeated) — the single definition of
+        the padding rule (device_chunk and the runner's key-schedule
+        setup must pad identically)."""
+        (lo, hi) = self.chunk_bounds(i)
+        sl = x[lo:hi]
+        pad = self.chunk_size - (hi - lo)
+        if pad:
+            sl = np.concatenate([sl, np.repeat(sl[:1], pad, axis=0)],
+                                axis=0)
+        return sl
+
     def device_chunk(self, i: int) -> tuple[ReportBatch, np.ndarray]:
         """Chunk i as device arrays, padded to chunk_size with dead
         lanes (row 0 repeated).  Returns (batch, live mask)."""
         from ..backend.vidpf_jax import BatchedCorrectionWords
 
         (lo, hi) = self.chunk_bounds(i)
-        pad = self.chunk_size - (hi - lo)
 
         def take(x):
-            if x is None:
-                return None
-            sl = x[lo:hi]
-            if pad:
-                sl = np.concatenate(
-                    [sl, np.repeat(sl[:1], pad, axis=0)], axis=0)
-            return jnp.asarray(sl)
+            return None if x is None \
+                else jnp.asarray(self.host_slice(x, i))
 
         a = self.arrays
         batch = ReportBatch(
@@ -186,40 +193,14 @@ class ChunkedIncrementalRunner(RoundPrograms):
         key schedules; uploading the whole chunk batch here would
         stream the full O(BITS) report store through the device,
         exactly the startup cost the chunked design avoids)."""
-        from ..backend.incremental import Carry
-
-        (lo, hi) = self.store.chunk_bounds(i)
-        size = self.store.chunk_size
-        pad = size - (hi - lo)
-
-        def take(x):
-            sl = x[lo:hi]
-            if pad:
-                sl = np.concatenate(
-                    [sl, np.repeat(sl[:1], pad, axis=0)], axis=0)
-            return sl
-
-        nonces = take(self.store.arrays["nonces"])
-        keys = take(self.store.arrays["keys"])
+        nonces = self.store.host_slice(self.store.arrays["nonces"], i)
+        keys = self.store.host_slice(self.store.arrays["keys"], i)
         (ext_rk, conv_rk) = self._rk_fn(jnp.asarray(nonces))
-
-        vid = self.bm.m.vidpf
-        bits = vid.BITS
-        seed = np.zeros((size, self.width, 16), np.uint8)
-        ctrl = np.zeros((size, self.width), bool)
-        carries = []
-        for a in range(2):
-            s = seed.copy()
-            s[:, 0, :] = keys[:, a]
-            c = ctrl.copy()
-            c[:, 0] = bool(a)
-            carries.append(Carry(
-                w=np.zeros((size, bits, self.width,
-                            vid.VALUE_LEN, self.bm.spec.num_limbs),
-                           np.uint32),
-                proof=np.zeros((size, bits, self.width, 32),
-                               np.uint8),
-                seed=s, ctrl=c))
+        carries = [
+            self.engine.init_carry(self.store.chunk_size, keys[:, a],
+                                   a, host=True)
+            for a in range(2)
+        ]
         return _ChunkState(carries=carries,
                            ext_rk=np.asarray(ext_rk),
                            conv_rk=np.asarray(conv_rk))

@@ -185,11 +185,13 @@ class AggregatorParty:
                     size: int):
         """One jitted program for the whole batched exchange (eager
         dispatch of the Keccak/NTT kernels at 10k reports costs more
-        than the math)."""
+        than the math).  Cached per round *kind* only — jax.jit
+        already specializes per (num, size) shape."""
         import jax
         import jax.numpy as jnp
 
-        key = (do_wc, use_jr, num, size)
+        del num, size  # shape specialization is jit's job
+        key = (do_wc, use_jr)
         fn = self._resolve_fns.get(key)
         if fn is not None:
             return fn
@@ -210,7 +212,7 @@ class AggregatorParty:
                 ver_bytes = peer[:, off:]
                 vlen = ver_bytes.shape[1] // elem
                 (ver1, in_range) = bm.spec.limbs_from_le_bytes(
-                    ver_bytes.reshape(num, vlen, elem))
+                    ver_bytes.reshape(ver_bytes.shape[0], vlen, elem))
                 verifier = bm.spec.add(verifier_own, ver1)
                 accept &= bm.bflp.decide(verifier)
                 accept &= jnp.all(in_range, axis=-1)

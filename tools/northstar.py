@@ -59,7 +59,6 @@ def main() -> None:
 
     from mastic_tpu import MasticCount
     from mastic_tpu.backend.mastic_jax import BatchedMastic
-    from mastic_tpu.backend.vidpf_jax import BatchedCorrectionWords
     from mastic_tpu.common import gen_rand
     from mastic_tpu.drivers.chunked import HostReportStore
     from mastic_tpu.drivers.heavy_hitters import HeavyHittersRun
