@@ -432,8 +432,7 @@ def bench_incremental_round(bm, num_reports: int, frontier: int,
     ]
     prefixes = tuple(p + (c,) for p in parents for c in (False, True))
     carried = needed_paths(parents, level - 1)
-    plan = RoundPlan(prefixes, level, bits, width,
-                     carried[level - 1], carried)
+    plan = RoundPlan(prefixes, level, bits, width, carried)
     rnd = round_inputs(plan)
 
     engine = IncrementalMastic(bm, width)
@@ -557,6 +556,10 @@ def main():
                         "(sets MASTIC_KECCAK_UNROLL; default 4 unless "
                         "the env var is already set; 1 = cheapest "
                         "compile)")
+    parser.add_argument("--keccak-pallas", action="store_true",
+                        help="route the Keccak permutation through "
+                        "the Pallas fused-VMEM kernel "
+                        "(MASTIC_KECCAK_PALLAS)")
     parser.add_argument("--watchdog", type=float, default=1500.0)
     parser.add_argument("--attach-timeout", type=float, default=60.0)
     parser.add_argument("--attach-retries", type=int, default=3)
@@ -571,6 +574,8 @@ def main():
         os.environ["MASTIC_KECCAK_UNROLL"] = str(args.keccak_unroll)
     else:
         os.environ.setdefault("MASTIC_KECCAK_UNROLL", "4")
+    if args.keccak_pallas:
+        os.environ["MASTIC_KECCAK_PALLAS"] = "1"
 
     # Pre-seed the fail-open record from the last verified run BEFORE
     # anything that can hang, so every exit path has a nonzero number
@@ -670,6 +675,8 @@ def main():
     PARTIAL["bits"] = args.bits
     PARTIAL["keccak_unroll"] = int(
         os.environ.get("MASTIC_KECCAK_UNROLL", "1"))
+    PARTIAL["keccak_pallas"] = \
+        os.environ.get("MASTIC_KECCAK_PALLAS", "0") == "1"
 
     if not args.headline_only:
         try:

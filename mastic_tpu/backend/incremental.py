@@ -87,23 +87,41 @@ def needed_paths(prefixes: Sequence[Path], level: int,
     ]
 
 
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, (n - 1).bit_length())
+
+
 class RoundPlan:
     """Host-side runtime inputs for one incremental round.
 
-    Mirrors the reference's lazily-materialized tree for candidate set
-    `prefixes` at `level`: per depth d, the live nodes are
-    needed[d] = both children of every ancestor of `prefixes` at depth
-    d-1 (lexicographic), which is exactly the reference's BFS
-    materialization order (mastic.py:258-287).
+    Carried depths use *creation-time layouts*: the node set at depth
+    d only ever shrinks across rounds (prefixes descend from
+    survivors), so rows written when depth d was the new level stay
+    valid forever and no per-round compaction gather is needed — the
+    binder assembly gathers exactly the live rows by position.
+    `layouts[d]` is depth d's creation layout (list of paths, one per
+    node slot); the plan appends `layout_new` for the new level.
+
+    The eval-proof binders still cover the reference's BFS
+    materialization order byte-exactly (mastic.py:258-287): per depth,
+    the CURRENT needed set in lexicographic order, located inside the
+    creation layouts.  Gather index arrays are bucketed to the next
+    power of two of the live row count, so one compiled program serves
+    a range of levels and the hashed/gathered data scales with the
+    live tree, not the bits x width capacity.
     """
 
     def __init__(self, prefixes: Sequence[Path], level: int, bits: int,
-                 width: int, prev_paths: Optional[list[Path]],
-                 carried_paths: list[list[Path]]):
+                 width: int, layouts: list):
         if any(len(p) != level + 1 for p in prefixes):
             raise ValueError("prefix with incorrect length")
         if len(set(prefixes)) != len(prefixes):
             raise ValueError("candidate prefixes are non-unique")
+        if level != len(layouts):
+            raise ValueError(
+                f"incremental rounds must advance one level at a time "
+                f"(have layouts for depths 0..{len(layouts) - 1}, "
+                f"round is at level {level})")
         half = width // 2
         self.level = level
         self.width = width
@@ -112,32 +130,25 @@ class RoundPlan:
         anc = _ancestors(prefixes, level)
         if any(len(a) > half for a in anc):
             raise ValueError("frontier exceeds padded width")
-        needed = needed_paths(prefixes, level, anc)
-        self.needed = needed
+        # The new level's layout: both children of every ancestor at
+        # level-1, lexicographic (== needed_paths(...)[level]).
+        if level == 0:
+            self.layout_new = [(False,), (True,)]
+        else:
+            self.layout_new = [p + (b,) for p in anc[level - 1]
+                               for b in (False, True)]
+        full = list(layouts) + [self.layout_new]
+        pos_maps = [{p: i for (i, p) in enumerate(lay)} for lay in full]
 
-        # Prune gather: position of needed[d] inside the previously
-        # carried paths at depth d (identity row for the new level).
-        self.prune_idx = np.zeros((bits, width), np.int32)
-        self.counts = np.zeros(bits, np.int32)
-        for d in range(level):
-            pos = {p: i for (i, p) in enumerate(carried_paths[d])}
-            for (i, p) in enumerate(needed[d]):
-                self.prune_idx[d, i] = pos[p]
-            self.counts[d] = len(needed[d])
-        self.prune_idx[level] = np.arange(width)
-        self.counts[level] = len(needed[level])
-
-        # Parents of the new level inside the previous frontier state.
+        # Parents of the new level inside the previous frontier state
+        # (depth level-1's creation layout).
         self.parent_idx = np.zeros(half, np.int32)
         if level == 0:
             self.parent_count = 1
         else:
-            assert prev_paths is not None
-            pos = {p: i for (i, p) in enumerate(prev_paths)}
-            parents = anc[level - 1]
-            for (i, p) in enumerate(parents):
-                self.parent_idx[i] = pos[p]
-            self.parent_count = len(parents)
+            for (i, p) in enumerate(anc[level - 1]):
+                self.parent_idx[i] = pos_maps[level - 1][p]
+            self.parent_count = len(anc[level - 1])
 
         # Node-proof binder bytes for the new children (runtime data;
         # one row per child, same for every report).
@@ -146,69 +157,85 @@ class RoundPlan:
         self.node_binder = np.zeros((width, self.binder_capacity),
                                     np.uint8)
         head = to_le_bytes(bits, 2) + to_le_bytes(level, 2)
-        for (i, p) in enumerate(needed[level]):
+        for (i, p) in enumerate(self.layout_new):
             row = head + encode_path(p)
             self.node_binder[i, :len(row)] = np.frombuffer(row, np.uint8)
         self.binder_len = 4 + (level + 1 + 7) // 8
 
-        # Onehot-check permutation: flatten (depth, node) rows of the
-        # carried proof arrays into BFS order.
+        # Onehot rows: the current needed set per depth (children of
+        # the current ancestors), BFS/lex order, as flattened
+        # (depth * width + slot) positions into the carried proofs.
         rows = []
         for d in range(level + 1):
-            rows += [d * width + i for i in range(len(needed[d]))]
-        self.onehot_perm = np.zeros(bits * width, np.int32)
-        self.onehot_perm[:len(rows)] = rows
+            if d == level:
+                current = self.layout_new
+            elif d == 0:
+                current = [(False,), (True,)]
+            else:
+                current = [p + (b,) for p in anc[d - 1]
+                           for b in (False, True)]
+            rows += [d * width + pos_maps[d][p] for p in current]
         self.onehot_rows = len(rows)
+        cap_o = _next_pow2(max(1, self.onehot_rows))
+        self.onehot_idx = np.zeros(cap_o, np.int32)
+        self.onehot_idx[:len(rows)] = rows
 
-        # Payload-check permutation over (depth, parent-slot) rows:
-        # parents at depth d are anc[d] located inside needed[d].
-        self.internal_idx = np.zeros((bits, half), np.int32)
-        prows = []
+        # Payload rows: parent minus its two children, per internal
+        # node (the current ancestors at depths < level), each row a
+        # triple of flattened positions.
+        (par, left, right) = ([], [], [])
         for d in range(level):
-            pos = {p: i for (i, p) in enumerate(needed[d])}
-            for (i, p) in enumerate(anc[d]):
-                self.internal_idx[d, i] = pos[p]
-                prows.append(d * half + i)
-        self.payload_perm = np.zeros(bits * half, np.int32)
-        self.payload_perm[:len(prows)] = prows
-        self.payload_rows = len(prows)
+            for p in anc[d]:
+                par.append(d * width + pos_maps[d][p])
+                left.append((d + 1) * width + pos_maps[d + 1][p + (False,)])
+                right.append((d + 1) * width + pos_maps[d + 1][p + (True,)])
+        self.payload_rows = len(par)
+        cap_p = _next_pow2(max(1, self.payload_rows))
+        self.payload_parent = np.zeros(cap_p, np.int32)
+        self.payload_left = np.zeros(cap_p, np.int32)
+        self.payload_right = np.zeros(cap_p, np.int32)
+        self.payload_parent[:len(par)] = par
+        self.payload_left[:len(left)] = left
+        self.payload_right[:len(right)] = right
 
-        # Output gather: position of each prefix in needed[level].
-        pos = {p: i for (i, p) in enumerate(needed[level])}
+        # Output gather: position of each prefix in the new layout.
         self.out_idx = np.zeros(half, np.int32)
         for (i, p) in enumerate(self.prefixes):
-            self.out_idx[i] = pos[p]
+            self.out_idx[i] = pos_maps[level][p]
         self.num_out = len(self.prefixes)
 
 
 class IncrementalRound(NamedTuple):
-    """Traced inputs derived from a RoundPlan."""
-    level: jax.Array          # () int32
-    prune_idx: jax.Array      # (BITS, W)
-    parent_idx: jax.Array     # (W/2,)
-    parent_count: jax.Array   # () int32
-    node_binder: jax.Array    # (W, B)
-    binder_len: jax.Array     # () int32
-    onehot_perm: jax.Array    # (BITS*W,)
-    onehot_rows: jax.Array    # () int32
-    internal_idx: jax.Array   # (BITS, W/2)
-    payload_perm: jax.Array   # (BITS*W/2,)
-    payload_rows: jax.Array   # () int32
-    out_idx: jax.Array        # (W/2,)
+    """Traced inputs derived from a RoundPlan.  The gather index
+    arrays are capacity-bucketed (power of two >= live rows), so jit
+    specializes per bucket — O(log(bits * width)) programs over a full
+    heavy-hitters run instead of one per level."""
+    level: jax.Array           # () int32
+    parent_idx: jax.Array      # (W/2,)
+    parent_count: jax.Array    # () int32
+    node_binder: jax.Array     # (W, B)
+    binder_len: jax.Array      # () int32
+    onehot_idx: jax.Array      # (capO,) flattened (depth*W + slot)
+    onehot_rows: jax.Array     # () int32
+    payload_parent: jax.Array  # (capP,)
+    payload_left: jax.Array    # (capP,)
+    payload_right: jax.Array   # (capP,)
+    payload_rows: jax.Array    # () int32
+    out_idx: jax.Array         # (W/2,)
 
 
 def round_inputs(plan: RoundPlan) -> IncrementalRound:
     return IncrementalRound(
         level=jnp.int32(plan.level),
-        prune_idx=jnp.asarray(plan.prune_idx),
         parent_idx=jnp.asarray(plan.parent_idx),
         parent_count=jnp.int32(plan.parent_count),
         node_binder=jnp.asarray(plan.node_binder),
         binder_len=jnp.int32(plan.binder_len),
-        onehot_perm=jnp.asarray(plan.onehot_perm),
+        onehot_idx=jnp.asarray(plan.onehot_idx),
         onehot_rows=jnp.int32(plan.onehot_rows),
-        internal_idx=jnp.asarray(plan.internal_idx),
-        payload_perm=jnp.asarray(plan.payload_perm),
+        payload_parent=jnp.asarray(plan.payload_parent),
+        payload_left=jnp.asarray(plan.payload_left),
+        payload_right=jnp.asarray(plan.payload_right),
         payload_rows=jnp.int32(plan.payload_rows),
         out_idx=jnp.asarray(plan.out_idx),
     )
@@ -261,15 +288,11 @@ class IncrementalMastic:
         (num_reports, _bits, width, value_len, n) = carry.w.shape
         half = width // 2
 
-        # 1. Prune all carried depths to the ancestors of the live
-        # candidate set (one vectorized gather per array).
-        def prune(x):
-            idx = rnd.prune_idx.reshape(
-                (1, self.bits, width) + (1,) * (x.ndim - 3))
-            return jnp.take_along_axis(x, idx, axis=2)
-
-        w_all = prune(carry.w)
-        proof_all = prune(carry.proof)
+        # 1. Carried depths keep their creation-time layouts (the live
+        # set only shrinks, so no compaction gather — the binder
+        # assembly below reads exactly the live rows by position).
+        w_all = carry.w
+        proof_all = carry.proof
 
         # 2. Gather the surviving parents from the frontier state.
         pseed = carry.seed[:, rnd.parent_idx, :]
@@ -345,35 +368,34 @@ class IncrementalMastic:
     def _eval_proof(self, agg_id: int, verify_key: bytes, ctx: bytes,
                     w_all, proof_all, rnd: IncrementalRound):
         """The three checks over the carried tree, hashed with
-        runtime-length binders (scalar semantics: mastic.py:219-247)."""
+        runtime-length binders (scalar semantics: mastic.py:219-247).
+        Only the live rows are gathered/hashed — the index arrays are
+        capacity-bucketed, so both the memory traffic and the sponge
+        work scale with the live tree, not bits x width."""
         bm = self.bm
         spec = bm.spec
         (num_reports, bits, width, value_len, n) = w_all.shape
-        half = width // 2
+        w_flat = w_all.reshape(num_reports, bits * width, value_len, n)
+        proof_flat = proof_all.reshape(num_reports, bits * width,
+                                       PROOF_SIZE)
 
-        # Payload rows: parent w minus its two children, per depth.
-        parent_w = jnp.take_along_axis(
-            w_all, rnd.internal_idx[None, :, :, None, None], axis=2)
-        left = w_all[:, 1:, 0::2]
-        right = w_all[:, 1:, 1::2]
-        diff = spec.sub(parent_w[:, :bits - 1],
-                        spec.add(left, right))
-        diff_bytes = spec.plain_to_le_bytes(diff).reshape(
-            num_reports, (bits - 1) * half, -1)
-        row_bytes = diff_bytes.shape[-1]
-        # Compact rows into BFS order with the host permutation, then
-        # hash the runtime-length prefix.
-        payload_binder = diff_bytes[:, rnd.payload_perm[
-            :(bits - 1) * half]].reshape(num_reports, -1)
+        # Payload rows: parent w minus its two children, per internal
+        # node (triple gathers of exactly the live rows).
+        diff = spec.sub(
+            w_flat[:, rnd.payload_parent],
+            spec.add(w_flat[:, rnd.payload_left],
+                     w_flat[:, rnd.payload_right]))
+        diff_bytes = spec.plain_to_le_bytes(diff)
+        row_bytes = value_len * spec.encoded_size
+        payload_binder = diff_bytes.reshape(num_reports, -1)
         payload_check = turbo_shake128_dynamic(
             _prefixed(payload_binder, ctx, USAGE_PAYLOAD_CHECK, bm.m.ID),
             _prefix_len(ctx, USAGE_PAYLOAD_CHECK, bm.m.ID)
             + rnd.payload_rows * row_bytes,
             1, PROOF_SIZE)
 
-        onehot_binder = proof_all.reshape(
-            num_reports, bits * width, PROOF_SIZE)[
-            :, rnd.onehot_perm].reshape(num_reports, -1)
+        onehot_binder = proof_flat[:, rnd.onehot_idx].reshape(
+            num_reports, -1)
         onehot_check = turbo_shake128_dynamic(
             _prefixed(onehot_binder, ctx, USAGE_ONEHOT_CHECK, bm.m.ID),
             _prefix_len(ctx, USAGE_ONEHOT_CHECK, bm.m.ID)

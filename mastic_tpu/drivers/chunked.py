@@ -158,7 +158,7 @@ class ChunkedIncrementalRunner(RoundPrograms):
     """Drives backend/incremental.py chunk by chunk.
 
     External contract matches _IncrementalRunner (round(),
-    width/fallback/carried_paths/prev_paths, checkpoint arrays), so
+    width/fallback/layouts, checkpoint arrays), so
     HeavyHittersRun can swap it in when a chunk size is given; the
     jitted round programs are shared via RoundPrograms.
     """
@@ -184,8 +184,7 @@ class ChunkedIncrementalRunner(RoundPrograms):
         self._rk_fn = jax.jit(lambda n: bm.vidpf.roundkeys(ctx, n))
         self.chunks = [self._init_chunk(i)
                        for i in range(store.num_chunks)]
-        self.carried_paths: list = []
-        self.prev_paths = None
+        self.layouts: list = []  # per-depth creation layouts
 
     def _init_chunk(self, i: int) -> _ChunkState:
         """Initial carries and AES round keys for chunk i — built from
@@ -305,8 +304,8 @@ class ChunkedIncrementalRunner(RoundPrograms):
                     self.store.chunk_size * evals_per_report / wall, 1),
             })
 
-        self.carried_paths = plan.needed
-        self.prev_paths = plan.needed[level]
+        assert level == len(self.layouts)
+        self.layouts.append(plan.layout_new)
 
         metrics = RoundMetrics(level=level,
                                frontier_width=len(prefixes),

@@ -192,7 +192,13 @@ def compute_heavy_hitters(mastic: Mastic, ctx: bytes, thresholds: dict,
     return run.result()
 
 
-_CKPT_VERSION = 2  # v2 added the chunk_size meta field (0 = unchunked)
+# v2 added the chunk_size meta field (0 = unchunked); v3 added the
+# per-depth creation layouts (carries are no longer compacted per
+# round, so the row arrangement can't be derived from the last
+# aggregation parameter alone).  v1/v2 checkpoints hold compacted
+# carries, whose arrangement IS needed_paths(last prefixes) — still
+# restorable.
+_CKPT_VERSION = 3
 
 
 def _ckpt_binding(verify_key: bytes, ctx: bytes,
@@ -352,12 +358,15 @@ class HeavyHittersRun:
         from .chunked import ChunkedIncrementalRunner
 
         chunked = isinstance(self.runner, ChunkedIncrementalRunner)
+        num_layouts = (len(self.runner.layouts)
+                       if self.runner is not None else 0)
         data = {
             "meta": np.array(
                 [_CKPT_VERSION, self.level, int(self.done),
                  0 if self.runner is None else 1,
                  self.mastic.vidpf.BITS, self.num_reports,
-                 self.store.chunk_size if chunked else 0], np.int64),
+                 self.store.chunk_size if chunked else 0,
+                 num_layouts], np.int64),
             "binding": _ckpt_binding(self.verify_key, self.ctx,
                                      self.thresholds),
             "prefixes": _paths_to_array(self.prefixes),
@@ -370,6 +379,9 @@ class HeavyHittersRun:
         if self.prev_agg_params:
             data["last_prefixes"] = _paths_to_array(
                 self.prev_agg_params[-1][1])
+        for d in range(num_layouts):
+            data[f"layout_{d}"] = _paths_to_array(
+                self.runner.layouts[d])
         if chunked:
             data["width"] = np.int64(self.runner.width)
             data["fallback"] = self.runner.fallback
@@ -399,12 +411,16 @@ class HeavyHittersRun:
         arrays = np.load(io.BytesIO(data), allow_pickle=False)
         meta = [int(x) for x in arrays["meta"]]
         version = meta[0]
+        num_layouts = 0
         if version == 1:
             (_, level, done, incremental, bits, num_reports) = meta
             chunk_size = 0
-        elif version == _CKPT_VERSION:
+        elif version == 2:
             (_, level, done, incremental, bits, num_reports,
              chunk_size) = meta
+        elif version == _CKPT_VERSION:
+            (_, level, done, incremental, bits, num_reports,
+             chunk_size, num_layouts) = meta
         else:
             raise ValueError(f"unknown checkpoint version {version}")
         restored_n = (store.num_reports if store is not None
@@ -445,6 +461,17 @@ class HeavyHittersRun:
              wc)
             for (i, (lvl, wc)) in enumerate(zip(prev_levels, prev_wc))
         ]
+        def restored_layouts():
+            """v3 saves the creation layouts; v1/v2 carries were
+            compacted every round, so their arrangement equals the
+            needed-paths of the last aggregation parameter."""
+            if version >= 3:
+                return [
+                    _paths_from_array(arrays[f"layout_{d}"])
+                    for d in range(num_layouts)
+                ]
+            return needed_paths(last_prefixes, prev_levels[-1])
+
         if isinstance(run.runner, ChunkedIncrementalRunner) \
                 and prev_levels:
             from ..backend.incremental import IncrementalMastic
@@ -458,9 +485,7 @@ class HeavyHittersRun:
                 runner._agg_fn = None
             runner.fallback = np.asarray(arrays["fallback"], bool)
             runner.load_state(arrays, runner.store.num_chunks)
-            carried = needed_paths(last_prefixes, prev_levels[-1])
-            runner.carried_paths = carried
-            runner.prev_paths = carried[prev_levels[-1]]
+            runner.layouts = restored_layouts()
         elif run.runner is not None and prev_levels:
             from ..backend.incremental import IncrementalMastic
 
@@ -484,9 +509,7 @@ class HeavyHittersRun:
                 from ..parallel.mesh import place_reports
                 runner.carries = [place_reports(runner.mesh, c)
                                   for c in runner.carries]
-            carried = needed_paths(last_prefixes, prev_levels[-1])
-            runner.carried_paths = carried
-            runner.prev_paths = carried[prev_levels[-1]]
+            runner.layouts = restored_layouts()
         return run
 
 
@@ -497,7 +520,7 @@ class RoundPrograms:
     (drivers/chunked.ChunkedIncrementalRunner) runners execute the
     identical round program — one definition keeps their semantics
     locked together.  Subclasses provide bm / verify_key / ctx /
-    engine / width / prev_paths / carried_paths and a _grow(width)."""
+    engine / width / layouts and a _grow(width)."""
 
     def _fns(self):
         if self._eval_fn is None:
@@ -539,7 +562,7 @@ class RoundPrograms:
             try:
                 return RoundPlan(prefixes, level,
                                  self.bm.m.vidpf.BITS, self.width,
-                                 self.prev_paths, self.carried_paths)
+                                 self.layouts)
             except ValueError as err:
                 if "exceeds padded width" not in str(err):
                     raise
@@ -579,8 +602,7 @@ class _IncrementalRunner(RoundPrograms):
                                    batch.keys[:, a], a)
             for a in range(2)
         ]
-        self.carried_paths: list = []
-        self.prev_paths = None
+        self.layouts: list = []  # per-depth creation layouts
         self._eval_fn = None
         self._agg_fn = None
         self._wc_fns: dict = {}
@@ -621,8 +643,8 @@ class _IncrementalRunner(RoundPrograms):
             self.ext_rk, self.conv_rk, self.batch.cws)
         self.fallback |= ~np.asarray(ok)
         self.carries = [c0, c1]
-        self.carried_paths = plan.needed
-        self.prev_paths = plan.needed[level]
+        assert level == len(self.layouts)
+        self.layouts.append(plan.layout_new)
 
         metrics = RoundMetrics(level=level,
                                frontier_width=len(prefixes),

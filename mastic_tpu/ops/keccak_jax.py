@@ -34,6 +34,12 @@ _U32 = jnp.uint32
 # round-trips.
 UNROLL = int(os.environ.get("MASTIC_KECCAK_UNROLL", "1"))
 
+# Route the permutation through the Pallas fused-VMEM kernel
+# (ops/keccak_pallas.py) instead of the scan.  Read once at import;
+# interpret mode is selected per call from the active backend so the
+# CPU test fabric can exercise the kernel path bit-exactly.
+USE_PALLAS = os.environ.get("MASTIC_KECCAK_PALLAS", "0") == "1"
+
 
 def _rotl64(lo: jax.Array, hi: jax.Array, n: int):
     """Rotate the 64-bit lanes (hi||lo) left by static n."""
@@ -108,6 +114,13 @@ def keccak_p1600(lo: jax.Array, hi: jax.Array, num_rounds: int = 12):
     is called at every tree node and the unrolled form dominated XLA
     compile time.
     """
+
+    if USE_PALLAS:
+        from .keccak_pallas import keccak_p1600_pallas
+
+        return keccak_p1600_pallas(
+            lo, hi, num_rounds,
+            interpret=jax.default_backend() == "cpu")
 
     def body(carry, rcs):
         (rc_lo, rc_hi) = rcs

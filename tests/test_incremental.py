@@ -59,8 +59,7 @@ def test_incremental_eval_proof_matches_from_root():
     (ext_rk, conv_rk) = bm.vidpf.roundkeys(CTX, batch.nonces)
     carries = [engine.init_carry(num, batch.keys[:, a], a)
                for a in range(2)]
-    carried_paths: list = []
-    prev_paths = None
+    layouts: list = []
 
     # A pruned frontier path: keep only prefixes under 10*.
     frontiers = [
@@ -70,8 +69,7 @@ def test_incremental_eval_proof_matches_from_root():
         [(True, False, True, False), (True, False, True, True)],
     ]
     for (level, prefixes) in enumerate(frontiers):
-        plan = RoundPlan(tuple(prefixes), level, 4, 8, prev_paths,
-                         carried_paths)
+        plan = RoundPlan(tuple(prefixes), level, 4, 8, layouts)
         rnd = round_inputs(plan)
         proofs = []
         outs = []
@@ -83,8 +81,7 @@ def test_incremental_eval_proof_matches_from_root():
             assert bool(np.all(np.asarray(ok)))
             proofs.append(np.asarray(proof))
             outs.append(np.asarray(out))
-        carried_paths = plan.needed
-        prev_paths = plan.needed[level]
+        layouts.append(plan.layout_new)
 
         # From-root reference for the same agg param.
         agg_param = (level, tuple(prefixes), False)

@@ -30,3 +30,33 @@ def test_turbo_shake128_nd_batch():
     for i in range(2):
         for j in range(3):
             assert bytes(got[i, j]) == turbo_shake128(bytes(batch[i, j]), 1, 32)
+
+
+import pytest  # noqa: E402  (module tail: only the pallas test below)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("flat", [5, 600])
+def test_keccak_pallas_call_plumbing(flat):
+    """The pallas_call plumbing (lane-major transpose, padding, grid —
+    incl. a batch whose lane-padded size is not a _BLOCK_B multiple)
+    is bit-exact vs the scan path for a single round in interpret
+    mode.  The round math itself is the scan path's _keccak_round,
+    shared by construction; a full 12-round unrolled kernel takes
+    minutes of interpret compile on the CPU fabric, so one round
+    suffices here."""
+    pytest.importorskip("jax.experimental.pallas")
+    import jax.numpy as jnp
+
+    from mastic_tpu.ops.keccak_jax import keccak_p1600
+    from mastic_tpu.ops.keccak_pallas import keccak_p1600_pallas
+
+    rng = np.random.default_rng(3)
+    lo = jnp.asarray(rng.integers(0, 1 << 32, (flat, 25),
+                                  dtype=np.uint32))
+    hi = jnp.asarray(rng.integers(0, 1 << 32, (flat, 25),
+                                  dtype=np.uint32))
+    (alo, ahi) = keccak_p1600(lo, hi, 1)
+    (blo, bhi) = keccak_p1600_pallas(lo, hi, 1, interpret=True)
+    np.testing.assert_array_equal(np.asarray(alo), np.asarray(blo))
+    np.testing.assert_array_equal(np.asarray(ahi), np.asarray(bhi))
