@@ -31,20 +31,88 @@ def hash_attribute(mastic: Mastic, attribute: str) -> tuple:
 def aggregate_by_attribute(mastic: Mastic, ctx: bytes,
                            attributes: Sequence[str], reports: list,
                            verify_key: Optional[bytes] = None,
-                           metrics_out: Optional[list] = None) -> list:
+                           metrics_out: Optional[list] = None,
+                           chunk_size: Optional[int] = None) -> list:
     """Aggregate `reports` grouped by the collector's attributes of
     interest.  Returns [(attribute, aggregate)] pairs; appends a
-    RoundMetrics record to `metrics_out` (observability, SURVEY §5)."""
+    RoundMetrics record to `metrics_out` (observability, SURVEY §5).
+
+    With `chunk_size`, reports stream through the single aggregation
+    round in fixed-size blocks (the device never holds the whole
+    batch; full chunks share one compiled program, the tail runs at
+    its natural size), bit-identical to the unchunked result."""
     if verify_key is None:
         verify_key = gen_rand(mastic.VERIFY_KEY_SIZE)
     bm = BatchedMastic(mastic)
-    batch = bm.marshal_reports(reports)
     level = mastic.vidpf.BITS - 1
     prefixes = tuple(hash_attribute(mastic, a) for a in attributes)
     if len(set(prefixes)) != len(prefixes):
         raise ValueError("attribute hash collision; increase BITS")
     agg_param = (level, prefixes, True)
     assert mastic.is_valid(agg_param, [])
-    result = run_round(bm, verify_key, ctx, agg_param, batch, reports,
-                       metrics_out=metrics_out)
+    if chunk_size is not None and chunk_size < 1:
+        raise ValueError(f"chunk_size must be >= 1, got {chunk_size}")
+    if chunk_size is None:
+        batch = bm.marshal_reports(reports)
+        result = run_round(bm, verify_key, ctx, agg_param, batch,
+                           reports, metrics_out=metrics_out)
+    else:
+        result = _run_round_chunked(bm, verify_key, ctx, agg_param,
+                                    reports, chunk_size, metrics_out)
     return list(zip(attributes, result))
+
+
+def _run_round_chunked(bm: BatchedMastic, verify_key: bytes,
+                       ctx: bytes, agg_param, reports: list,
+                       chunk_size: int,
+                       metrics_out: Optional[list]) -> list:
+    """One from-root aggregation round streamed chunk by chunk
+    (heavy_hitters.run_round semantics, accumulated aggregates)."""
+    import numpy as np
+
+    from ..common import vec_add
+    from ..backend.schedule import LevelSchedule
+    from .heavy_hitters import _round_fn, _vk_array, finalize_round
+
+    (level, prefixes, do_weight_check) = agg_param
+    num = len(reports)
+    rows = len(prefixes) * (1 + bm.m.flp.OUTPUT_LEN)
+    agg_shares = [[bm.m.field(0)] * rows for _ in range(2)]
+    accept_all = np.zeros(num, bool)
+    ok_all = np.ones(num, bool)
+    eval_ok = np.zeros(num, bool)
+    wc_ok: Optional[np.ndarray] = None
+    jr_ok: Optional[np.ndarray] = None
+
+    for lo in range(0, num, chunk_size):
+        chunk = reports[lo:lo + chunk_size]
+        hi = lo + len(chunk)
+        batch = bm.marshal_reports(chunk)
+        (agg0, agg1, accept, ok, checks) = _round_fn(
+            bm, ctx, agg_param)(_vk_array(verify_key), batch)
+        ok_all[lo:hi] = np.asarray(ok)
+        accept_all[lo:hi] = np.asarray(accept)
+        eval_ok[lo:hi] = np.asarray(checks["eval_proof"])
+        if "weight_check" in checks:
+            if wc_ok is None:
+                wc_ok = np.zeros(num, bool)
+            wc_ok[lo:hi] = np.asarray(checks["weight_check"])
+        if "joint_rand" in checks:
+            if jr_ok is None:
+                jr_ok = np.zeros(num, bool)
+            jr_ok[lo:hi] = np.asarray(checks["joint_rand"])
+        for (a, arr) in ((0, agg0), (1, agg1)):
+            agg_shares[a] = vec_add(agg_shares[a],
+                                    bm.agg_share_to_host(arr))
+
+    sched = LevelSchedule(prefixes, level, bm.m.vidpf.BITS)
+    checks = {"eval_proof": eval_ok}
+    if wc_ok is not None:
+        checks["weight_check"] = wc_ok
+    if jr_ok is not None:
+        checks["joint_rand"] = jr_ok
+    return finalize_round(
+        bm, verify_key, ctx, agg_param, reports, ok_all, accept_all,
+        checks, agg_shares, padded_width=sched.total_nodes,
+        nodes_evaluated=sched.total_nodes, metrics_out=metrics_out,
+        extra={"chunk_size": chunk_size})

@@ -228,12 +228,13 @@ class ChunkedIncrementalRunner(RoundPrograms):
 
     def round(self, agg_param,
               metrics_out: Optional[list] = None) -> list:
-        from .heavy_hitters import splice_rejected
         from ..backend.incremental import round_inputs
+        from .heavy_hitters import _vk_array, splice_rejected
 
         (level, prefixes, do_weight_check) = agg_param
         plan = self._plan(prefixes, level)
         rnd = round_inputs(plan)
+        vk_arr = _vk_array(self.verify_key)
         (eval_fn, agg_fn) = self._fns()
         rows = len(prefixes) * (1 + self.bm.m.flp.OUTPUT_LEN)
 
@@ -265,7 +266,8 @@ class ChunkedIncrementalRunner(RoundPrograms):
                                   (batch, dev_c0, dev_c1, ext_rk,
                                    conv_rk))
             (c0, c1, out0, out1, accept, ok) = eval_fn(
-                dev_c0, dev_c1, rnd, ext_rk, conv_rk, batch.cws)
+                vk_arr, dev_c0, dev_c1, rnd, ext_rk, conv_rk,
+                batch.cws)
             cs.carries[0] = _carry_to_host(c0)
             cs.carries[1] = _carry_to_host(c1)
             ok = np.asarray(ok)
@@ -275,7 +277,7 @@ class ChunkedIncrementalRunner(RoundPrograms):
             eval_ok_all[lo:hi] = accept[:hi - lo]
             if do_weight_check:
                 (wc_checks, wc_ok) = self._wc_fn(level)(
-                    batch, c0.w[:, 0, :2], c1.w[:, 0, :2])
+                    vk_arr, batch, c0.w[:, 0, :2], c1.w[:, 0, :2])
                 self.fallback[lo:hi] |= ~np.asarray(wc_ok)[:hi - lo]
                 wc_accept = np.asarray(wc_checks["weight_check"])
                 wc_ok_all[lo:hi] = wc_accept[:hi - lo]

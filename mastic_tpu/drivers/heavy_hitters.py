@@ -49,20 +49,27 @@ def get_threshold(thresholds: dict, prefix: tuple) -> int:
     return thresholds["default"]
 
 
-def _round_fn(bm: BatchedMastic, verify_key: bytes, ctx: bytes,
-              agg_param):
+def _vk_array(verify_key: bytes) -> jax.Array:
+    return jnp.asarray(np.frombuffer(verify_key, np.uint8))
+
+
+def _round_fn(bm: BatchedMastic, ctx: bytes, agg_param):
     """The jitted full-round function, cached on the BatchedMastic so
     repeated rounds with the same aggregation parameter (or repeated
-    aggregate_by_attribute calls) reuse the compiled program."""
+    aggregate_by_attribute calls) reuse the compiled program.
+
+    The verify key is a TRACED input, not a baked constant: a fresh
+    per-collection key must not recompile the round (it previously
+    did — every fresh-key test run re-paid the full XLA compile)."""
     cache = getattr(bm, "_round_cache", None)
     if cache is None:
         cache = {}
         bm._round_cache = cache
-    key = (verify_key, ctx, agg_param)
+    key = (ctx, agg_param)
     fn = cache.get(key)
     if fn is None:
-        fn = jax.jit(lambda b: bm.round_device_checks(verify_key, ctx,
-                                                      agg_param, b))
+        fn = jax.jit(lambda vk, b: bm.round_device_checks(
+            vk, ctx, agg_param, b))
         cache[key] = fn
     return fn
 
@@ -84,38 +91,59 @@ def run_round(bm: BatchedMastic, verify_key: bytes, ctx: bytes,
     from ..backend.schedule import LevelSchedule
 
     (level, prefixes, do_weight_check) = agg_param
-    (agg0, agg1, accept, ok, checks) = _round_fn(bm, verify_key, ctx,
-                                                 agg_param)(batch)
+    (agg0, agg1, accept, ok, checks) = _round_fn(bm, ctx, agg_param)(
+        _vk_array(verify_key), batch)
     accept = np.asarray(accept).copy()
     ok = np.asarray(ok)
-    num_reports = accept.shape[0]
     sched = LevelSchedule(prefixes, level, bm.m.vidpf.BITS)
+    agg_shares = [bm.agg_share_to_host(a) for a in (agg0, agg1)]
+    result = finalize_round(
+        bm, verify_key, ctx, agg_param, reports, ok, accept,
+        {k: np.asarray(v) for (k, v) in checks.items()}, agg_shares,
+        padded_width=sched.total_nodes,
+        nodes_evaluated=sched.total_nodes, metrics_out=metrics_out)
+    if accept_out is not None:
+        accept_out.append(accept)
+    return result
+
+
+def finalize_round(bm: BatchedMastic, verify_key: bytes, ctx: bytes,
+                   agg_param, reports: Optional[list],
+                   ok: np.ndarray, accept: np.ndarray, checks: dict,
+                   agg_shares: list, padded_width: int,
+                   nodes_evaluated: int,
+                   metrics_out: Optional[list],
+                   extra: Optional[dict] = None) -> list:
+    """Shared from-root round finalization (run_round and the chunked
+    attribute-metrics round): metrics record with per-check rejection
+    attribution, the XOF-rejection scalar-fallback splice, unshard.
+
+    From-root rounds evaluate the whole child grid; the beta shares
+    on weight-check rounds reuse the depth-0 children (contrast the
+    reference, whose get_beta_share re-evaluates them,
+    mastic.py:235-236)."""
+    (level, prefixes, _do_weight_check) = agg_param
+    num_reports = accept.shape[0]
     metrics = RoundMetrics(level=level, frontier_width=len(prefixes),
-                           padded_width=sched.total_nodes,
+                           padded_width=padded_width,
                            reports_total=num_reports)
     attribute_rejections(metrics, checks["eval_proof"],
                          checks.get("weight_check"),
                          checks.get("joint_rand"), device_ok=ok)
-    # From-root rounds evaluate the whole child grid; the beta shares
-    # on weight-check rounds reuse the depth-0 children (contrast the
-    # reference, whose get_beta_share re-evaluates them,
-    # mastic.py:235-236).
-    count_round_ops(metrics, bm.m, num_reports, sched.total_nodes,
+    count_round_ops(metrics, bm.m, num_reports, nodes_evaluated,
                     include_key_setup=True)
     count_round_bytes(metrics, bm.m, agg_param, num_reports)
     metrics.xof_fallbacks = int((~ok).sum())
+    if extra:
+        metrics.extra.update(extra)
 
-    agg_shares = [bm.agg_share_to_host(a) for a in (agg0, agg1)]
     splice_rejected(bm.m, verify_key, ctx, agg_param, reports,
                     ok, accept, agg_shares)
     metrics.accepted = int(accept.sum())
     metrics.rejected_fallback = int((~ok & ~accept).sum())
-    if accept_out is not None:
-        accept_out.append(accept)
     if metrics_out is not None:
         metrics_out.append(metrics)
-    num = int(accept.sum())
-    return bm.m.unshard(agg_param, agg_shares, num)
+    return bm.m.unshard(agg_param, agg_shares, int(accept.sum()))
 
 
 def scalar_round_out_shares(m: Mastic, verify_key: bytes, ctx: bytes,
@@ -525,9 +553,9 @@ class RoundPrograms:
     def _fns(self):
         if self._eval_fn is None:
             engine = self.engine
-            (vk, ctx) = (self.verify_key, self.ctx)
+            ctx = self.ctx
 
-            def both(c0, c1, rnd, ext_rk, conv_rk, cws):
+            def both(vk, c0, c1, rnd, ext_rk, conv_rk, cws):
                 (c0, proof0, out0, ok0) = engine.agg_round(
                     0, vk, ctx, c0, rnd, ext_rk, conv_rk, cws)
                 (c1, proof1, out1, ok1) = engine.agg_round(
@@ -535,22 +563,24 @@ class RoundPrograms:
                 accept = jnp.all(proof0 == proof1, axis=-1)
                 return (c0, c1, out0, out1, accept, ok0 & ok1)
 
+            # Carries are donated: both runners replace them with the
+            # outputs (resident keeps them resident; chunked re-uploads
+            # fresh buffers every chunk).  The verify key is traced so
+            # a fresh per-collection key reuses the compiled program.
+            self._eval_fn = jax.jit(both, donate_argnums=(1, 2))
+
             def agg(out0, out1, accept):
                 return (self.bm.aggregate(out0, accept),
                         self.bm.aggregate(out1, accept))
 
-            # Carries are donated: both runners replace them with the
-            # outputs (resident keeps them resident; chunked re-uploads
-            # fresh buffers every chunk).
-            self._eval_fn = jax.jit(both, donate_argnums=(0, 1))
             self._agg_fn = jax.jit(agg)
         return (self._eval_fn, self._agg_fn)
 
     def _wc_fn(self, level: int):
         fn = self._wc_fns.get(level)
         if fn is None:
-            (bm, vk, ctx) = (self.bm, self.verify_key, self.ctx)
-            fn = jax.jit(lambda b, w0, w1: bm.weight_check_device(
+            (bm, ctx) = (self.bm, self.ctx)
+            fn = jax.jit(lambda vk, b, w0, w1: bm.weight_check_device(
                 vk, ctx, level, b, w0, w1))
             self._wc_fns[level] = fn
         return fn
@@ -639,6 +669,7 @@ class _IncrementalRunner(RoundPrograms):
         plan = self._plan(prefixes, level)
         (eval_fn, agg_fn) = self._fns()
         (c0, c1, out0, out1, accept, ok) = eval_fn(
+            _vk_array(self.verify_key),
             self.carries[0], self.carries[1], round_inputs(plan),
             self.ext_rk, self.conv_rk, self.batch.cws)
         self.fallback |= ~np.asarray(ok)
@@ -657,7 +688,8 @@ class _IncrementalRunner(RoundPrograms):
             # the two root children) — a small FLP-only program, not a
             # second from-root tree eval.
             (wc_checks, wc_ok) = self._wc_fn(level)(
-                self.batch, c0.w[:, 0, :2], c1.w[:, 0, :2])
+                _vk_array(self.verify_key), self.batch,
+                c0.w[:, 0, :2], c1.w[:, 0, :2])
             self.fallback |= ~np.asarray(wc_ok)
             checks.update({k: np.asarray(v)
                            for (k, v) in wc_checks.items()})

@@ -71,6 +71,22 @@ def test_attribute_metrics():
     assert result == [("Greece", 6), ("Mexico", 0),
                       ("United States", 4)]
 
+    # The chunked streaming path (11 reports -> 4+4+3) is
+    # bit-identical, with per-round metrics.
+    from mastic_tpu.common import gen_rand
+    vk = gen_rand(mastic.VERIFY_KEY_SIZE)
+    (m_full, m_chunked) = ([], [])
+    full = aggregate_by_attribute(
+        mastic, ctx, ["Greece", "Mexico", "United States"], reports,
+        verify_key=vk, metrics_out=m_full)
+    chunked = aggregate_by_attribute(
+        mastic, ctx, ["Greece", "Mexico", "United States"], reports,
+        verify_key=vk, metrics_out=m_chunked, chunk_size=4)
+    assert full == chunked == result
+    assert m_full[0].accepted == m_chunked[0].accepted == len(reports)
+    assert m_chunked[0].extra["chunk_size"] == 4
+    assert m_full[0].bytes_upload == m_chunked[0].bytes_upload
+
 
 def test_communication_report_matches_formulas():
     sizes = communication_report(print_fn=lambda *_: None)
