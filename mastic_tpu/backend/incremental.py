@@ -128,7 +128,11 @@ class RoundPlan:
         self.prefixes = tuple(prefixes)
 
         anc = _ancestors(prefixes, level)
-        if any(len(a) > half for a in anc):
+        # Ancestor depths (< level) each spawn both children inside a
+        # width-W row, so they must fit W/2; the frontier itself lives
+        # inside layout_new (2*|anc[level-1]| <= W by the same check),
+        # so a full-width frontier is fine.
+        if any(len(anc[d]) > half for d in range(level)):
             raise ValueError("frontier exceeds padded width")
         # The new level's layout: both children of every ancestor at
         # level-1, lexicographic (== needed_paths(...)[level]).
@@ -198,8 +202,9 @@ class RoundPlan:
         self.payload_left[:len(left)] = left
         self.payload_right[:len(right)] = right
 
-        # Output gather: position of each prefix in the new layout.
-        self.out_idx = np.zeros(half, np.int32)
+        # Output gather: position of each prefix in the new layout
+        # (sized to the full width — the frontier may fill it).
+        self.out_idx = np.zeros(width, np.int32)
         for (i, p) in enumerate(self.prefixes):
             self.out_idx[i] = pos_maps[level][p]
         self.num_out = len(self.prefixes)
@@ -221,7 +226,7 @@ class IncrementalRound(NamedTuple):
     payload_left: jax.Array    # (capP,)
     payload_right: jax.Array   # (capP,)
     payload_rows: jax.Array    # () int32
-    out_idx: jax.Array         # (W/2,)
+    out_idx: jax.Array         # (W,)
 
 
 def round_inputs(plan: RoundPlan) -> IncrementalRound:
@@ -281,7 +286,7 @@ class IncrementalMastic:
         proof and the (padded) truncated out share.
 
         Returns (carry', eval_proof (R, 32), out_share
-        (R, W/2*(1+OUTPUT_LEN), n), ok (R,)).
+        (R, W*(1+OUTPUT_LEN), n), ok (R,)).
         """
         bm = self.bm
         spec = bm.spec
