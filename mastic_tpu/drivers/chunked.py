@@ -22,6 +22,7 @@ footprint vs the total host footprint — the numbers that justify the
 design at shapes where the unchunked carry cannot exist on one chip.
 """
 
+import os
 import time
 from typing import NamedTuple, Optional
 
@@ -33,6 +34,146 @@ from ..common import vec_add
 from ..metrics import (RoundMetrics, attribute_rejections,
                        count_round_bytes, count_round_ops)
 from ..backend.mastic_jax import BatchedMastic, ReportBatch
+
+# Memory budgets the feasibility guard enforces (PERF.md §4 derives
+# the envelope at the north-star shape).  The device default is a
+# conservative single-chip HBM allowance (16 GiB parts, XLA scratch
+# headroom); <= 0 disables a budget.
+DEVICE_BUDGET_DEFAULT = 12 << 30
+
+
+def _device_budget() -> int:
+    return int(os.environ.get("MASTIC_DEVICE_BUDGET_BYTES",
+                              DEVICE_BUDGET_DEFAULT))
+
+
+def _host_budget() -> int:
+    env = os.environ.get("MASTIC_HOST_BUDGET_BYTES")
+    if env is not None:
+        return int(env)
+    try:
+        total = (os.sysconf("SC_PAGE_SIZE")
+                 * os.sysconf("SC_PHYS_PAGES"))
+    except (ValueError, OSError):
+        return 0
+    # A cgroup limit below physical RAM is where the OOM kill actually
+    # lands — honor it (v2 then v1; "max" / absent means unlimited).
+    for path in ("/sys/fs/cgroup/memory.max",
+                 "/sys/fs/cgroup/memory/memory.limit_in_bytes"):
+        try:
+            with open(path) as f:
+                text = f.read().strip()
+            if text.isdigit():
+                total = min(total, int(text))
+        except OSError:
+            pass
+    return int(total * 0.9)
+
+
+def per_report_bytes(bm: BatchedMastic, width: int) -> dict:
+    """Analytic per-report footprint of the chunked execution model
+    (the arrays init_carry / roundkeys / HostReportStore actually
+    allocate; tests/test_chunked.py pins these against the real
+    allocations).  All three scale linearly in reports, so the
+    envelope below is exact, not an estimate."""
+    vid = bm.vidpf
+    spec = bm.spec
+    bits = vid.BITS
+    limb_bytes = vid.VALUE_LEN * spec.num_limbs * 4
+    # Carry (backend/incremental.py Carry, both aggregators): the
+    # w/proof planes carry the whole BITS x width capacity; seed/ctrl
+    # only the newest depth.
+    carry = 2 * (bits * width * (limb_bytes + 32) + width * (16 + 1))
+    # Fixed-key AES schedules (vidpf_jax.roundkeys): 2 x (11, 16).
+    roundkeys = 2 * 11 * 16
+    # Report store row (HostReportStore.from_batch).
+    store = (16                              # nonce
+             + bits * (16 + 2 + limb_bytes + 32)   # correction words
+             + 2 * 16                        # VIDPF keys
+             + bm.m.flp.PROOF_LEN * spec.num_limbs * 4
+             + 32)                           # helper seed
+    if bm.m.flp.JOINT_RAND_LEN > 0:
+        store += 32 + 2 * 32                 # leader seed + peer parts
+    return {"carry": carry, "roundkeys": roundkeys, "store": store}
+
+
+def memory_envelope(bm: BatchedMastic, chunk_size: int, width: int,
+                    num_reports: int) -> dict:
+    """The (chunk_size, width) feasibility envelope: what one chunk
+    costs the device and what the whole run costs the host, plus the
+    largest chunk size that fits the device budget at this width.
+    PERF.md §4 walks the arithmetic at the 1M x 256 north star."""
+    per = per_report_bytes(bm, width)
+    per_chunk = per["carry"] + per["roundkeys"] + per["store"]
+    device_budget = _device_budget()
+    host_budget = _host_budget()
+    # Carries and round keys are allocated per padded chunk row (the
+    # tail chunk is padded to chunk_size); only the store keeps exactly
+    # num_reports rows.
+    padded_rows = -(-num_reports // chunk_size) * chunk_size
+    host_total = (padded_rows * (per["carry"] + per["roundkeys"])
+                  + num_reports * per["store"])
+    return {
+        "bits": bm.vidpf.BITS, "width": width,
+        "chunk_size": chunk_size, "num_reports": num_reports,
+        "per_report_bytes": per,
+        "device_bytes_per_chunk": chunk_size * per_chunk,
+        "host_bytes_total": host_total,
+        "device_budget_bytes": device_budget,
+        "host_budget_bytes": host_budget,
+        "max_chunk_size_at_width": (device_budget // per_chunk
+                                    if device_budget > 0 else 0),
+        "min_hosts": (-(-host_total // host_budget)
+                      if host_budget > 0 else 1),
+    }
+
+
+def check_envelope(bm: BatchedMastic, chunk_size: int, width: int,
+                   num_reports: int,
+                   n_device_shards: int = 1) -> dict:
+    """Refuse shapes outside the envelope with an actionable message
+    (the guard VERDICT r4 asked for): the device check bounds one
+    chunk's live state — per chip when the chunk's report axis is
+    mesh-sharded over `n_device_shards` devices; the host check bounds
+    the carry store and names the multi-host answer when one host
+    cannot hold it."""
+    env = memory_envelope(bm, chunk_size, width, num_reports)
+    per_chip = -(-env["device_bytes_per_chunk"] // n_device_shards)
+    max_chunk = env["max_chunk_size_at_width"] * n_device_shards
+    if env["device_budget_bytes"] > 0 \
+            and per_chip > env["device_budget_bytes"]:
+        chip = (f" across {n_device_shards} chips"
+                if n_device_shards > 1 else "")
+        if max_chunk == 0:
+            raise ValueError(
+                f"width {width} at {bm.vidpf.BITS} bits needs "
+                f"{per_chip / 2**30:.1f} GiB per chip{chip} even for a "
+                f"single-report chunk (budget "
+                f"{env['device_budget_bytes'] / 2**30:.1f} GiB) — the "
+                f"width itself is infeasible at this budget; raise "
+                f"MASTIC_DEVICE_BUDGET_BYTES or shard the chunk over "
+                f"more devices")
+        raise ValueError(
+            f"chunk of {chunk_size} reports needs "
+            f"{per_chip / 2**30:.1f} GiB per chip{chip} "
+            f"at width {width} (budget "
+            f"{env['device_budget_bytes'] / 2**30:.1f} GiB); the largest "
+            f"feasible chunk_size at this width is "
+            f"{max_chunk} — shrink the chunk, or "
+            f"raise MASTIC_DEVICE_BUDGET_BYTES if the chip has more HBM")
+    if env["host_budget_bytes"] > 0 \
+            and env["host_bytes_total"] > env["host_budget_bytes"]:
+        raise ValueError(
+            f"{num_reports} reports need "
+            f"{env['host_bytes_total'] / 2**30:.1f} GiB of host memory "
+            f"at width {width} (budget "
+            f"{env['host_budget_bytes'] / 2**30:.1f} GiB); split the "
+            f"report store across >= {env['min_hosts']} hosts, each "
+            f"running its own chunked runner over its shard (carries, "
+            f"round keys and store are all per-report; only the "
+            f"per-round aggregate shares cross hosts), or raise "
+            f"MASTIC_HOST_BUDGET_BYTES")
+    return env
 
 
 class HostReportStore:
@@ -165,7 +306,7 @@ class ChunkedIncrementalRunner(RoundPrograms):
 
     def __init__(self, bm: BatchedMastic, verify_key: bytes, ctx: bytes,
                  store: HostReportStore, reports: Optional[list] = None,
-                 width: int = 8):
+                 width: int = 8, n_device_shards: int = 1):
         from ..backend.incremental import IncrementalMastic
 
         self.bm = bm
@@ -176,6 +317,9 @@ class ChunkedIncrementalRunner(RoundPrograms):
         self.num_reports = store.num_reports
         self.fallback = np.zeros(self.num_reports, bool)
         self.width = max(4, width)
+        self.n_device_shards = max(1, n_device_shards)
+        check_envelope(bm, store.chunk_size, self.width,
+                       self.num_reports, self.n_device_shards)
         self.mesh = None  # set via parallel.mesh.shard_incremental_runner
         self.engine = IncrementalMastic(bm, self.width)
         self._eval_fn = None
@@ -207,6 +351,10 @@ class ChunkedIncrementalRunner(RoundPrograms):
     def _grow(self, width: int) -> None:
         from ..backend.incremental import Carry, IncrementalMastic
 
+        n = (self.mesh.shape["reports"] if self.mesh is not None
+             else self.n_device_shards)
+        check_envelope(self.bm, self.store.chunk_size, width,
+                       self.num_reports, n)
         pad = width - self.width
         for cs in self.chunks:
             for a in range(2):

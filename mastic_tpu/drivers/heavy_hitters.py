@@ -296,7 +296,9 @@ class HeavyHittersRun:
             self.batch = None
             self.num_reports = store.num_reports
             self.runner = ChunkedIncrementalRunner(
-                self.bm, verify_key, ctx, store, reports)
+                self.bm, verify_key, ctx, store, reports,
+                n_device_shards=(mesh.shape["reports"]
+                                 if mesh is not None else 1))
         else:
             self.store = None
             self.batch = self.bm.marshal_reports(reports)
@@ -503,10 +505,18 @@ class HeavyHittersRun:
         if isinstance(run.runner, ChunkedIncrementalRunner) \
                 and prev_levels:
             from ..backend.incremental import IncrementalMastic
+            from .chunked import check_envelope
 
             runner = run.runner
             width = int(arrays["width"])
             if width != runner.width:
+                # A checkpoint taken at a grown width must re-clear
+                # the envelope on the restoring host/chip — adopting
+                # it unchecked would OOM with a raw allocator error
+                # instead of the guard's refusal.
+                check_envelope(runner.bm, runner.store.chunk_size,
+                               width, runner.num_reports,
+                               runner.n_device_shards)
                 runner.width = width
                 runner.engine = IncrementalMastic(runner.bm, width)
                 runner._eval_fn = None

