@@ -616,6 +616,7 @@ def main():
     if not args.cpu and tunnel_expected:
         if not probe_attach(args.attach_timeout, args.attach_retries):
             timer.cancel()
+            PARTIAL["platform"] = "unattached"
             emit(error="device attach probe failed "
                  f"({args.attach_retries}x{args.attach_timeout:.0f}s; "
                  "tunnel down)")
@@ -627,6 +628,9 @@ def main():
     devices = jax.devices()
     stamp("device-up", devices=devices)
     on_chip = devices[0].platform != "cpu"
+    # Stamped into every emit from here on, so a CPU-sim rate can
+    # never be mistaken for a chip rate in a round artifact.
+    PARTIAL["platform"] = devices[0].platform
 
     from mastic_tpu import MasticCount
     from mastic_tpu.backend.mastic_jax import BatchedMastic

@@ -453,6 +453,21 @@ class HeavyHittersRun:
              chunk_size, num_layouts) = meta
         else:
             raise ValueError(f"unknown checkpoint version {version}")
+        if chunk_size == 0 and store is not None:
+            # Passing a store would silently build the OTHER runner
+            # kind and die on (or worse, skip) the missing per-chunk
+            # carry arrays — refuse descriptively instead.
+            raise ValueError(
+                "checkpoint was taken by the resident (unchunked) "
+                "runner; restore it with scalar reports, not a store")
+        if chunk_size and store is None and reports is None:
+            raise ValueError(
+                "chunked checkpoint needs its report store (or the "
+                "scalar reports to rebuild one)")
+        if chunk_size == 0 and reports is None:
+            raise ValueError(
+                "resident checkpoint needs the scalar reports it was "
+                "taken over")
         restored_n = (store.num_reports if store is not None
                       else len(reports))
         if bits != mastic.vidpf.BITS or num_reports != restored_n:

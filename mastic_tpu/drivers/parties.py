@@ -161,6 +161,13 @@ class AggregatorParty:
         size = wire.prep_share_size(self.m, agg_param)
         num = len(self.reports)
         p = self._prep
+        if len(peer_blob) != num * size:
+            # A protocol-level refusal, not a numpy reshape traceback:
+            # a truncated or oversized exchange from a misbehaving
+            # peer aborts the round loudly and attributably.
+            raise ValueError(
+                f"malformed prep-share exchange from peer: got "
+                f"{len(peer_blob)} bytes, expected {num} x {size}")
         peer = np.frombuffer(peer_blob, np.uint8).reshape(num, size)
         use_jr = (self.m.flp.JOINT_RAND_LEN > 0 and do_wc)
         fn = self._resolve_fn(do_wc, use_jr, num, size)
@@ -237,6 +244,14 @@ class AggregatorParty:
         joint-rand confirmation (prep_next semantics) per report."""
         num = len(self.reports)
         nbytes = (num + 7) // 8
+        if len(resolution) < nbytes:
+            # Same protocol-level refusal as the leader's resolve():
+            # a truncating peer aborts loudly, not via numpy/struct
+            # tracebacks mid-parse.
+            raise ValueError(
+                f"malformed resolution from leader: got "
+                f"{len(resolution)} bytes, accept bitmap alone needs "
+                f"{nbytes}")
         accept = np.unpackbits(
             np.frombuffer(resolution[:nbytes], np.uint8),
             bitorder="little")[:num].astype(bool)
@@ -245,7 +260,12 @@ class AggregatorParty:
         jr_seed = (None if self._prep.joint_rand_seed is None
                    else np.asarray(self._prep.joint_rand_seed))
         for r in range(num):
-            (msg, rest) = wire.unframe(rest)
+            try:
+                (msg, rest) = wire.unframe(rest)
+            except Exception as exc:
+                raise ValueError(
+                    f"malformed resolution from leader: prep msg "
+                    f"{r} of {num} truncated") from exc
             if not accept[r]:
                 continue
             if use_jr:
@@ -425,6 +445,13 @@ class ProcessCollector:
         # leader payload: accept bitmap + agg share
         share_size = wire.agg_share_size(self.m, agg_param)
         nbytes = len(leader_msg) - share_size
+        if nbytes != (self.num_reports + 7) // 8 \
+                or len(helper_msg) != share_size:
+            raise ValueError(
+                f"malformed round payload: leader sent "
+                f"{len(leader_msg)} bytes (want bitmap "
+                f"{(self.num_reports + 7) // 8} + share {share_size}), "
+                f"helper sent {len(helper_msg)} (want {share_size})")
         accept = np.unpackbits(
             np.frombuffer(leader_msg[:nbytes], np.uint8),
             bitorder="little")[:self.num_reports].astype(bool)

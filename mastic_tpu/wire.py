@@ -173,6 +173,12 @@ def frame(payload: bytes) -> bytes:
 
 
 def unframe(buf: bytes) -> tuple[bytes, bytes]:
-    """Pop one length-prefixed message: -> (payload, rest)."""
+    """Pop one length-prefixed message: -> (payload, rest).  A
+    truncated header or payload raises (returning a silently-short
+    payload would turn a framing error into a content mismatch)."""
+    if len(buf) < 4:
+        raise ValueError("truncated frame header")
     (length,) = struct.unpack("<I", buf[:4])
+    if len(buf) < 4 + length:
+        raise ValueError("truncated frame payload")
     return (buf[4:4 + length], buf[4 + length:])

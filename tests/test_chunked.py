@@ -151,6 +151,36 @@ def test_chunked_checkpoint_roundtrip() -> None:
     assert ref.result() == resumed.result()
 
 
+def test_checkpoint_runner_kind_mismatch_refused() -> None:
+    """Restoring a resident checkpoint with a store (or a chunked one
+    with neither store nor reports) must fail descriptively, not with
+    a KeyError on missing carry arrays (ADVICE r4)."""
+    from mastic_tpu.drivers.chunked import HostReportStore
+
+    m = MasticCount(3)
+    reports = _tampered_reports(m)
+    vk = gen_rand(m.VERIFY_KEY_SIZE)
+    thresholds = {"default": 2}
+
+    resident = HeavyHittersRun(m, CTX, thresholds, reports,
+                               verify_key=vk)
+    resident.step()
+    resident_blob = resident.to_bytes()
+    chunked = HeavyHittersRun(m, CTX, thresholds, reports,
+                              verify_key=vk, chunk_size=4)
+    chunked.step()
+    chunked_blob = chunked.to_bytes()
+
+    bm = BatchedMastic(m)
+    store = HostReportStore.from_batch(bm.marshal_reports(reports), 4)
+    with pytest.raises(ValueError, match="resident"):
+        HeavyHittersRun.from_bytes(m, CTX, thresholds, None, vk,
+                                   resident_blob, store=store)
+    with pytest.raises(ValueError, match="report store"):
+        HeavyHittersRun.from_bytes(m, CTX, thresholds, None, vk,
+                                   chunked_blob)
+
+
 def test_chunked_width_growth_matches_resident() -> None:
     """A frontier that outgrows the initial padded width: 8 distinct
     3-bit prefixes in a 5-bit tree with threshold 1 force _grow at

@@ -60,3 +60,31 @@ def test_keccak_pallas_call_plumbing(flat):
     (blo, bhi) = keccak_p1600_pallas(lo, hi, 1, interpret=True)
     np.testing.assert_array_equal(np.asarray(alo), np.asarray(blo))
     np.testing.assert_array_equal(np.asarray(ahi), np.asarray(bhi))
+
+
+@pytest.mark.slow
+def test_keccak_pallas_chained_rounds_match_scan():
+    """All 12 rounds through the pallas boundary, one single-round
+    kernel per round (round_range pins each round's constant), must
+    equal the 12-round scan path.  This validates the multi-round
+    state handoff and the ROUND_CONSTANTS start offset that the
+    single kernel's unrolled form bakes in — without the >1 h
+    interpret compile of that form (VERDICT r4 ask #5)."""
+    pytest.importorskip("jax.experimental.pallas")
+    import jax.numpy as jnp
+
+    from mastic_tpu.ops.keccak_jax import keccak_p1600
+    from mastic_tpu.ops.keccak_pallas import keccak_p1600_pallas
+
+    rng = np.random.default_rng(5)
+    lo = jnp.asarray(rng.integers(0, 1 << 32, (7, 25), dtype=np.uint32))
+    hi = jnp.asarray(rng.integers(0, 1 << 32, (7, 25), dtype=np.uint32))
+    (want_lo, want_hi) = keccak_p1600(lo, hi, 12)
+    (got_lo, got_hi) = (lo, hi)
+    for r in range(12, 24):
+        (got_lo, got_hi) = keccak_p1600_pallas(
+            got_lo, got_hi, interpret=True, round_range=(r, r + 1))
+    np.testing.assert_array_equal(np.asarray(want_lo),
+                                  np.asarray(got_lo))
+    np.testing.assert_array_equal(np.asarray(want_hi),
+                                  np.asarray(got_hi))

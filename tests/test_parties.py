@@ -130,6 +130,45 @@ def test_process_separated_conformance(name, spec) -> None:
     assert result == vec["agg_result"]
 
 
+def test_resolve_rejects_malformed_peer_blob() -> None:
+    """A truncated or oversized prep-share exchange is refused as a
+    protocol error, not a numpy reshape traceback (ADVICE r4)."""
+    from mastic_tpu.drivers.parties import AggregatorParty
+
+    m = MasticCount(2)
+    ctx = b"wire test"
+    verify_key = gen_rand(m.VERIFY_KEY_SIZE)
+    blobs = []
+    for alpha in ((True, False), (False, True)):
+        nonce = gen_rand(m.NONCE_SIZE)
+        rand = gen_rand(m.RAND_SIZE)
+        (ps, shares) = m.shard(ctx, (alpha, 1), nonce, rand)
+        blobs.append([wire.encode_report(m, a, nonce, ps, shares[a])
+                      for a in range(2)])
+    parties = [AggregatorParty(m, a, verify_key, ctx)
+               for a in range(2)]
+    for a in range(2):
+        parties[a].load_reports([b[a] for b in blobs])
+    agg_param = (0, ((False,), (True,)), True)
+    _leader_blob = parties[0].prep_blob(agg_param)
+    helper_blob = parties[1].prep_blob(agg_param)
+
+    with pytest.raises(ValueError, match="malformed prep-share"):
+        parties[0].resolve(agg_param, helper_blob[:-1])
+    with pytest.raises(ValueError, match="malformed prep-share"):
+        parties[0].resolve(agg_param, helper_blob + b"\x00")
+    (accept, resolution) = parties[0].resolve(agg_param, helper_blob)
+    assert accept.all()
+
+    # Symmetric guard on the helper side: a truncating leader is a
+    # protocol error, whether the bitmap or a prep-msg frame is cut.
+    with pytest.raises(ValueError, match="malformed resolution"):
+        parties[1].confirm(agg_param, b"")
+    with pytest.raises(ValueError, match="truncated"):
+        parties[1].confirm(agg_param, resolution[:-1])
+    assert parties[1].confirm(agg_param, resolution).all()
+
+
 def test_process_separated_rejects_tampered_report() -> None:
     """A tampered VIDPF key is rejected by the process-separated
     round (the accept bitmap excludes it) without disturbing honest
