@@ -556,6 +556,9 @@ def main():
                         "(sets MASTIC_KECCAK_UNROLL; default 4 unless "
                         "the env var is already set; 1 = cheapest "
                         "compile)")
+    parser.add_argument("--aes-pallas", action="store_true",
+                        help="route the bitsliced AES through the "
+                        "Pallas fused-VMEM kernel (MASTIC_AES_PALLAS)")
     parser.add_argument("--keccak-pallas", action="store_true",
                         help="route the Keccak permutation through "
                         "the Pallas fused-VMEM kernel "
@@ -576,6 +579,8 @@ def main():
         os.environ.setdefault("MASTIC_KECCAK_UNROLL", "4")
     if args.keccak_pallas:
         os.environ["MASTIC_KECCAK_PALLAS"] = "1"
+    if args.aes_pallas:
+        os.environ["MASTIC_AES_PALLAS"] = "1"
 
     # Pre-seed the fail-open record from the last verified run BEFORE
     # anything that can hang, so every exit path has a nonzero number
@@ -681,6 +686,8 @@ def main():
         os.environ.get("MASTIC_KECCAK_UNROLL", "1"))
     PARTIAL["keccak_pallas"] = \
         os.environ.get("MASTIC_KECCAK_PALLAS", "0") == "1"
+    PARTIAL["aes_pallas"] = \
+        os.environ.get("MASTIC_AES_PALLAS", "0") == "1"
 
     if not args.headline_only:
         try:

@@ -274,6 +274,12 @@ class AggregatorParty:
                     accept[r] = False  # joint-rand confirmation failed
             elif msg != b"":
                 accept[r] = False
+        if rest:
+            # Strict length symmetry with resolve(): trailing bytes
+            # are a malformed exchange, not ignorable padding.
+            raise ValueError(
+                f"malformed resolution from leader: {len(rest)} "
+                f"trailing bytes after the last prep msg")
         return accept
 
     # -- aggregation -----------------------------------------------

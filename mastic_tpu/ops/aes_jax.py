@@ -15,6 +15,8 @@ asserted equal to the generated S-box table of the scalar reference
 drift.
 """
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -23,6 +25,11 @@ from ..aes import SBOX, _gf_mul
 from .sbox_tower import sbox_planes_tower
 
 _U8 = jnp.uint8
+
+# Route the bitsliced encrypt through the Pallas fused-VMEM kernel
+# (ops/aes_pallas.py).  Off by default: bit-exact by the chained
+# interpret suite, but unmeasured on real hardware.
+USE_PALLAS = os.environ.get("MASTIC_AES_PALLAS", "0") == "1"
 
 
 def _planes(x):
@@ -318,6 +325,9 @@ def aes128_encrypt_bitsliced(key_planes: jax.Array,
     packed batch element.  planes: (8, 16, ..., W) state planes whose
     middle dims broadcast against the keys (many blocks per batch
     element, e.g. every tree node of a report)."""
+    if USE_PALLAS:
+        from .aes_pallas import aes128_encrypt_bitsliced_pallas
+        return aes128_encrypt_bitsliced_pallas(key_planes, planes)
     extra = planes.ndim - 3
     kp = key_planes.reshape(
         (11, 8, 16) + (1,) * extra + key_planes.shape[-1:])

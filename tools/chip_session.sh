@@ -1,0 +1,44 @@
+#!/bin/bash
+# The first-chip-session checklist (VERDICT r4 ask #1), runnable as one
+# command so even a short tunnel window captures everything, in value
+# order:
+#   1. full default bench  -> headline + per-config numbers,
+#      BENCH_LAST_GOOD.json persisted with provenance
+#   2. Keccak unroll lever matrix on the headline shape
+#   3. Pallas fused-Keccak kernel on the headline shape (first-ever
+#      hardware execution of the 12-round form)
+# Each step has its own timeout; a hang or crash in one step must not
+# cost the rest of the window.  All JSON lines land in the log.
+set -u
+cd "$(dirname "$0")/.."
+LOG="${1:-/tmp/chip_session.log}"
+exec >>"$LOG" 2>&1
+
+echo "=== chip session $(date -u +%FT%TZ) rev=$(git rev-parse --short HEAD) ==="
+
+run() {
+    local name="$1"; shift
+    echo "--- $name: $* ---"
+    timeout 2400 "$@"
+    echo "--- $name: exit=$? ---"
+}
+
+# 1. The one number the framework exists for (writes BENCH_LAST_GOOD).
+run full python bench.py
+
+# 2. Lever matrix: unroll x pallas on the headline shape (headline-only
+# keeps each cell ~minutes; the full run above already owns last-good,
+# and headline-only cells never overwrite its configs).
+for unroll in 1 8; do
+    run "unroll-$unroll" python bench.py --headline-only \
+        --keccak-unroll "$unroll"
+done
+run pallas python bench.py --headline-only --keccak-pallas
+run aes-pallas python bench.py --headline-only --aes-pallas
+
+# Every on-chip run persists itself to BENCH_LAST_GOOD; end on the
+# default configuration so the cached record reflects the default
+# levers, not whichever matrix cell happened to run last.
+run default-final python bench.py --headline-only
+
+echo "=== chip session complete $(date -u +%FT%TZ) ==="

@@ -10,7 +10,11 @@ Checks, over mastic_tpu/, tests/, tools/ and the repo-root scripts:
    mypy gate, /root/reference/.github/workflows/test.yml:36-44 —
    mypy.ini is shipped for environments that have mypy);
 4. no `print(` in library code (drivers return data; observability is
-   the metrics dict).
+   the metrics dict);
+5. every annotation in the ANNOTATED layer resolves at runtime
+   (typing.get_type_hints over each public function, class and
+   method — undefined or misspelled type names fail here even
+   without mypy; mypy itself remains uninstallable in this image).
 
 Exit status 0 iff clean.  Run via `make lint` / `make ci`.
 """
@@ -129,6 +133,57 @@ def _prints_to_stderr(node: ast.Call) -> bool:
     return False
 
 
+def check_annotations_resolve() -> list:
+    """Check 5: every annotation in the ANNOTATED layer resolves at
+    runtime.  get_type_hints evaluates the annotation expressions
+    against the module globals, so a typo'd or un-imported type name
+    raises here — the executable subset of mypy's name resolution."""
+    import importlib
+    import inspect
+    import typing
+
+    problems = []
+    sys.path.insert(0, str(REPO))
+    for rel in ANNOTATED:
+        mod_name = rel[:-3].replace("/", ".")
+        try:
+            mod = importlib.import_module(mod_name)
+        except Exception as exc:
+            problems.append(f"{rel}: module does not import: "
+                            f"{type(exc).__name__}: {exc}")
+            continue
+        def unwrap(member):
+            """classmethod/staticmethod descriptors and properties
+            hide their function from inspect.isfunction — unwrap, or
+            their annotations would silently escape the check."""
+            if isinstance(member, (classmethod, staticmethod)):
+                return member.__func__
+            if isinstance(member, property):
+                return member.fget
+            return member
+
+        targets = []
+        for (name, obj) in vars(mod).items():
+            if getattr(obj, "__module__", None) != mod_name:
+                continue
+            if inspect.isfunction(obj):
+                targets.append((name, obj))
+            elif inspect.isclass(obj):
+                targets.append((name, obj))
+                for (mname, member) in vars(obj).items():
+                    member = unwrap(member)
+                    if inspect.isfunction(member):
+                        targets.append((f"{name}.{mname}", member))
+        for (tname, target) in targets:
+            try:
+                typing.get_type_hints(target)
+            except Exception as exc:
+                problems.append(
+                    f"{rel}: annotation on '{tname}' does not "
+                    f"resolve: {type(exc).__name__}: {exc}")
+    return problems
+
+
 def main() -> int:
     roots = [REPO / "mastic_tpu", REPO / "tests", REPO / "tools"]
     files = [REPO / "bench.py", REPO / "__graft_entry__.py"]
@@ -137,6 +192,7 @@ def main() -> int:
     problems = []
     for path in files:
         problems += check_file(path)
+    problems += check_annotations_resolve()
     for problem in problems:
         print(problem)
     print(f"lint: {len(files)} files, {len(problems)} problem(s)")
