@@ -202,12 +202,16 @@ class RoundPlan:
         self.payload_left[:len(left)] = left
         self.payload_right[:len(right)] = right
 
-        # Output gather: position of each prefix in the new layout
-        # (sized to the full width — the frontier may fill it).
-        self.out_idx = np.zeros(width, np.int32)
+        # Output gather: position of each prefix in the new layout,
+        # bucketed to the next power of two of the live frontier (the
+        # same treatment as the binder gathers): the out-share tensor
+        # and the masked aggregate scale with the frontier, not the
+        # padded width.
+        self.num_out = len(self.prefixes)
+        cap_out = _next_pow2(max(1, self.num_out))
+        self.out_idx = np.zeros(cap_out, np.int32)
         for (i, p) in enumerate(self.prefixes):
             self.out_idx[i] = pos_maps[level][p]
-        self.num_out = len(self.prefixes)
 
 
 class IncrementalRound(NamedTuple):
@@ -226,7 +230,7 @@ class IncrementalRound(NamedTuple):
     payload_left: jax.Array    # (capP,)
     payload_right: jax.Array   # (capP,)
     payload_rows: jax.Array    # () int32
-    out_idx: jax.Array         # (W,)
+    out_idx: jax.Array         # (capOut,)
 
 
 def round_inputs(plan: RoundPlan) -> IncrementalRound:
@@ -286,7 +290,8 @@ class IncrementalMastic:
         proof and the (padded) truncated out share.
 
         Returns (carry', eval_proof (R, 32), out_share
-        (R, W*(1+OUTPUT_LEN), n), ok (R,)).
+        (R, capOut*(1+OUTPUT_LEN), n), ok (R,)) — capOut the power-of-2
+        bucket of the live frontier, not the padded width.
         """
         bm = self.bm
         spec = bm.spec

@@ -84,9 +84,27 @@ def main() -> None:
                         help="weight of the uniform-tail reports "
                              "(sum mode)")
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--mesh", type=int, default=0,
+                        help="shard the chunk's report axis over this "
+                             "many devices (virtual CPU devices when "
+                             "the platform is cpu)")
     parser.add_argument("--out", type=str, default=None,
                         help="also write the JSON artifact here")
     args = parser.parse_args()
+
+    if args.mesh:
+        if args.chunk_size % args.mesh:
+            # Fail before the multi-minute shard phase, not after it.
+            parser.error(
+                f"--chunk-size {args.chunk_size} must be divisible by "
+                f"--mesh {args.mesh} (the chunk's report axis shards "
+                f"evenly across devices)")
+        # Virtual device count must be pinned before jax import.
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{args.mesh}").strip()
 
     t_start = time.time()
 
@@ -120,6 +138,10 @@ def main() -> None:
     bm = BatchedMastic(m)
     rng = np.random.default_rng(args.seed)
     platform = jax.devices()[0].platform
+    if args.mesh and args.mesh > jax.device_count():
+        print(f"--mesh {args.mesh} exceeds the {jax.device_count()} "
+              f"available {platform} device(s)", file=sys.stderr)
+        sys.exit(2)
     stamp(f"device={platform} inst={args.inst} reports={R} bits={bits} "
           f"chunk={C}")
 
@@ -201,8 +223,13 @@ def main() -> None:
 
     store = HostReportStore(arrays, R, C)
     vk = gen_rand(m.VERIFY_KEY_SIZE)
+    mesh = None
+    if args.mesh:
+        from mastic_tpu.parallel import make_mesh
+        mesh = make_mesh(args.mesh, nodes_axis=1)
+        stamp(f"mesh: report axis sharded over {args.mesh} devices")
     run = HeavyHittersRun(m, b"northstar", {"default": threshold},
-                          None, verify_key=vk, store=store)
+                          None, verify_key=vk, store=store, mesh=mesh)
 
     stamp(f"rounds: threshold={threshold} planted={args.planted}")
     agg_t0 = time.time()
@@ -231,6 +258,7 @@ def main() -> None:
     p50 = sorted(chunk_rates)[len(chunk_rates) // 2]
     out = {
         "inst": args.inst, "platform": platform,
+        "mesh_devices": args.mesh or 1,
         "reports": R, "bits": bits, "chunk_size": C,
         "levels": len(run.metrics),
         "threshold": threshold,
