@@ -27,7 +27,11 @@ import numpy as np
 _U32 = jnp.uint32
 _ONES32 = np.uint32(0xFFFFFFFF)
 _LANE = 128    # TPU vector lane width
-_BLOCK_M = 4   # blocks-per-grid-step (bounds VMEM: ~3 MB live planes)
+# Blocks-per-grid-step.  Mosaic requires the second-to-last block dim
+# to be a multiple of 8 (sublane tile) unless it equals the array dim,
+# so the block axis is padded up to a multiple of 8 below; VMEM stays
+# ~6 MB of live planes per grid step.
+_BLOCK_M = 8
 
 # ShiftRows permutation over the 16-byte axis (ops/aes_jax._SHIFT_ROWS).
 from .aes_jax import _SHIFT_ROWS
@@ -125,7 +129,7 @@ def aes128_encrypt_bitsliced_pallas(key_planes: jax.Array,
     # Pad the lane axis to the 128-wide tile and the block axis to the
     # grid block (dead lanes/blocks are sliced back off).
     w_pad = -(-w // _LANE) * _LANE - w
-    m_block = min(_BLOCK_M, m)
+    m_block = _BLOCK_M  # never narrower: Mosaic's 8-sublane tile rule
     m_pad = -(-m // m_block) * m_block - m
     if w_pad:
         state = jnp.pad(state, ((0, 0), (0, 0), (0, w_pad)))

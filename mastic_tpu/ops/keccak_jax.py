@@ -27,11 +27,11 @@ RATE = 168  # TurboSHAKE128 rate in bytes (21 lanes)
 _U32 = jnp.uint32
 
 # Round-loop unroll factor for the permutation scan (see keccak_p1600).
-# Read once at import.  The default 1 keeps compiles cheap (the CPU
-# test suite compiles every program once); bench.py exports
-# MASTIC_KECCAK_UNROLL (default 4, --keccak-unroll) before importing
-# this module so chip runs fuse rounds and skip the scan carry's HBM
-# round-trips.
+# Read once at import.  The default 1 keeps compiles cheap and was the
+# best rate observed in the r5 chip lever matrix (42.2M evals/s vs
+# 37.5M warm at unroll=4 and 36.7M at 8 on the 4096x64x256-bit
+# headline shape — single warm measurements per cell; nothing showed
+# manual round fusion helping).  bench.py --keccak-unroll overrides.
 UNROLL = int(os.environ.get("MASTIC_KECCAK_UNROLL", "1"))
 
 # Route the permutation through the Pallas fused-VMEM kernel
