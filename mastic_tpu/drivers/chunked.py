@@ -289,7 +289,9 @@ def _carry_to_device(carry):
 
 
 def _carry_bytes(carry) -> int:
-    return sum(np.asarray(x).nbytes for x in carry)
+    # .nbytes is metadata on both np and jax arrays — never forces a
+    # device->host transfer (np.asarray on a device carry would).
+    return sum(x.nbytes for x in carry)
 
 
 from .heavy_hitters import RoundPrograms

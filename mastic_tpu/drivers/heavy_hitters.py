@@ -273,7 +273,7 @@ class HeavyHittersRun:
                  verify_key: Optional[bytes] = None,
                  incremental: bool = True,
                  chunk_size: Optional[int] = None,
-                 store=None, mesh=None):
+                 store=None, mesh=None, batch=None):
         from .chunked import ChunkedIncrementalRunner, HostReportStore
 
         if verify_key is None:
@@ -284,14 +284,18 @@ class HeavyHittersRun:
         self.reports = reports
         self.verify_key = verify_key
         self.bm = BatchedMastic(mastic)
+        # `batch` lets a device-batched client pipeline (e.g.
+        # tools/northstar.py's shard_device loop) hand over marshalled
+        # arrays directly — at fleet scale there is no scalar report
+        # list to marshal (the scalar `reports` stays optional and is
+        # only needed by the XOF-rejection fallback).
         if chunk_size is not None or store is not None:
             # At-scale path: reports stream through the device chunk
-            # by chunk; the device never holds the whole batch (the
-            # scalar `reports` list is optional — only the rejection
-            # fallback needs it).
+            # by chunk; the device never holds the whole batch.
             if store is None:
                 store = HostReportStore.from_batch(
-                    self.bm.marshal_reports(reports), chunk_size)
+                    batch if batch is not None
+                    else self.bm.marshal_reports(reports), chunk_size)
             self.store = store
             self.batch = None
             self.num_reports = store.num_reports
@@ -301,8 +305,9 @@ class HeavyHittersRun:
                                  if mesh is not None else 1))
         else:
             self.store = None
-            self.batch = self.bm.marshal_reports(reports)
-            self.num_reports = len(reports)
+            self.batch = (batch if batch is not None
+                          else self.bm.marshal_reports(reports))
+            self.num_reports = int(self.batch.nonces.shape[0])
             self.runner = (
                 _IncrementalRunner(self.bm, verify_key, ctx, self.batch,
                                    reports)
@@ -429,9 +434,11 @@ class HeavyHittersRun:
     def from_bytes(cls, mastic: Mastic, ctx: bytes, thresholds: dict,
                    reports: Optional[list], verify_key: bytes,
                    data: bytes, store=None,
-                   mesh=None) -> "HeavyHittersRun":
+                   mesh=None, batch=None) -> "HeavyHittersRun":
         """Restore a checkpointed run over the same report store (a
-        chunked run may pass `store` instead of scalar reports)."""
+        chunked run may pass `store` instead of scalar reports; a
+        resident run built from a marshalled `batch` passes the same
+        batch back — there is no scalar list at fleet scale)."""
         import io
 
         from ..backend.incremental import (carry_from_arrays,
@@ -464,12 +471,13 @@ class HeavyHittersRun:
             raise ValueError(
                 "chunked checkpoint needs its report store (or the "
                 "scalar reports to rebuild one)")
-        if chunk_size == 0 and reports is None:
+        if chunk_size == 0 and reports is None and batch is None:
             raise ValueError(
-                "resident checkpoint needs the scalar reports it was "
-                "taken over")
+                "resident checkpoint needs the scalar reports (or the "
+                "marshalled batch) it was taken over")
         restored_n = (store.num_reports if store is not None
-                      else len(reports))
+                      else int(batch.nonces.shape[0])
+                      if batch is not None else len(reports))
         if bits != mastic.vidpf.BITS or num_reports != restored_n:
             raise ValueError("checkpoint does not match this "
                              "instantiation / report store")
@@ -487,7 +495,7 @@ class HeavyHittersRun:
         run = cls(mastic, ctx, thresholds, reports,
                   verify_key=verify_key, incremental=bool(incremental),
                   chunk_size=chunk_size if chunk_size else None,
-                  store=store, mesh=mesh)
+                  store=store, mesh=mesh, batch=batch)
         run.level = level
         run.done = bool(done)
         run.prefixes = _paths_from_array(arrays["prefixes"])
@@ -661,6 +669,24 @@ class _IncrementalRunner(RoundPrograms):
         self._eval_fn = None
         self._agg_fn = None
         self._wc_fns: dict = {}
+
+    def memory_accounting(self) -> dict:
+        """Device-resident footprint: both carries, the round keys and
+        the whole report batch live in HBM for the full run (the
+        chunked runner's memory_accounting is the streaming twin —
+        this mode only exists while the carry fits one chip)."""
+        # .nbytes is metadata — no device->host transfer.
+        carry = 2 * sum(x.nbytes for x in self.carries[0])
+        rk = self.ext_rk.nbytes + self.conv_rk.nbytes
+        batch = sum(x.nbytes
+                    for x in jax.tree_util.tree_leaves(self.batch))
+        return {
+            "chunk_size": 0,
+            "num_chunks": 1,
+            "device_bytes_total": carry + rk + batch,
+            "device_carry_bytes": carry,
+            "host_bytes_total": 0,
+        }
 
     def _grow(self, width: int) -> None:
         from ..backend.incremental import Carry, IncrementalMastic

@@ -90,3 +90,36 @@ def test_carry_arrays_roundtrip():
     restored = carry_from_arrays(carry_to_arrays(carry))
     for (a, b) in zip(carry, restored):
         assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_batch_built_run_checkpoints_and_restores():
+    """A run built from a marshalled batch (no scalar report list —
+    the fleet-scale ingestion path tools/northstar.py uses) must
+    checkpoint and restore bit-identically with the same batch passed
+    back, and must refuse a restore with neither reports nor batch."""
+    from mastic_tpu.backend.mastic_jax import BatchedMastic
+
+    mastic = MasticCount(BITS)
+    reports = _reports(mastic)
+    want = compute_heavy_hitters(mastic, CTX, THRESHOLDS, reports,
+                                 verify_key=VERIFY_KEY)
+    batch = BatchedMastic(mastic).marshal_reports(reports)
+
+    run = HeavyHittersRun(mastic, CTX, THRESHOLDS, None,
+                          verify_key=VERIFY_KEY, batch=batch)
+    assert run.step()
+    blob = run.to_bytes()
+    del run
+
+    try:
+        HeavyHittersRun.from_bytes(mastic, CTX, THRESHOLDS, None,
+                                   VERIFY_KEY, blob)
+        raise AssertionError("expected ValueError")
+    except ValueError:
+        pass
+
+    resumed = HeavyHittersRun.from_bytes(
+        mastic, CTX, THRESHOLDS, None, VERIFY_KEY, blob, batch=batch)
+    while resumed.step():
+        pass
+    assert resumed.result() == want

@@ -28,6 +28,10 @@ Examples:
   JAX_PLATFORMS=cpu python tools/northstar.py --reports 20000 --bits 256
   JAX_PLATFORMS=cpu python tools/northstar.py --inst sum --reports 10000 \\
       --bits 32 --max-weight 7
+  python tools/northstar.py --resident --reports 20000 --bits 256
+      # device-resident carries: the fast path on a tunnel-attached
+      # chip (chunked mode is transfer-bound there: it moves the full
+      # carry host<->device every level)
 """
 
 import argparse
@@ -84,6 +88,15 @@ def main() -> None:
                         help="weight of the uniform-tail reports "
                              "(sum mode)")
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--resident", action="store_true",
+                        help="keep carries device-resident for the "
+                             "whole run instead of streaming host "
+                             "chunks — the fast path whenever the "
+                             "full carry fits one chip's HBM (and the "
+                             "only fast path when the chip is reached "
+                             "over a network tunnel: chunked mode "
+                             "moves the full carry host<->device "
+                             "every level)")
     parser.add_argument("--mesh", type=int, default=0,
                         help="shard the chunk's report axis over this "
                              "many devices (virtual CPU devices when "
@@ -184,6 +197,7 @@ def main() -> None:
         lambda a, b, n, r: bm.shard_device(b"northstar", a, b, n, r))
     num_chunks = -(-R // C)
     arrays = None
+    chunk_batches = []
     shard_t0 = time.time()
     for i in range(num_chunks):
         (lo, hi) = (i * C, min((i + 1) * C, R))
@@ -198,6 +212,14 @@ def main() -> None:
         (batch, ok) = shard_fn(a, b, n, r)
         assert bool(np.all(np.asarray(ok))), \
             "XOF rejection fired during synthetic shard (p ~ 2^-32)"
+        if args.resident:
+            # Keep the (tail-trimmed) device arrays; no host store.
+            chunk_batches.append(jax.tree_util.tree_map(
+                lambda x: x[:hi - lo], batch))
+            if i == 0:
+                stamp(f"shard: chunk 0 done "
+                      f"({time.time() - shard_t0:.1f}s incl compile)")
+            continue
         chunk_store = HostReportStore.from_batch(batch, C)
         if arrays is None:
             arrays = {
@@ -221,15 +243,24 @@ def main() -> None:
     stamp(f"shard: {R} reports in {shard_wall:.1f}s "
           f"({R / shard_wall:.0f} reports/s)")
 
-    store = HostReportStore(arrays, R, C)
     vk = gen_rand(m.VERIFY_KEY_SIZE)
     mesh = None
     if args.mesh:
         from mastic_tpu.parallel import make_mesh
         mesh = make_mesh(args.mesh, nodes_axis=1)
         stamp(f"mesh: report axis sharded over {args.mesh} devices")
-    run = HeavyHittersRun(m, b"northstar", {"default": threshold},
-                          None, verify_key=vk, store=store, mesh=mesh)
+    if args.resident:
+        full_batch = jax.tree_util.tree_map(
+            lambda *xs: jnp.concatenate(xs, axis=0), *chunk_batches)
+        chunk_batches.clear()  # don't hold 2x the batch in HBM
+        run = HeavyHittersRun(m, b"northstar", {"default": threshold},
+                              None, verify_key=vk, batch=full_batch,
+                              mesh=mesh)
+    else:
+        store = HostReportStore(arrays, R, C)
+        run = HeavyHittersRun(m, b"northstar", {"default": threshold},
+                              None, verify_key=vk, store=store,
+                              mesh=mesh)
 
     stamp(f"rounds: threshold={threshold} planted={args.planted}")
     agg_t0 = time.time()
@@ -239,12 +270,18 @@ def main() -> None:
     while run.step():
         mx = run.metrics[-1]
         evals_total += mx.node_evals
-        rates = [c["node_evals_per_sec"] for c in mx.extra["chunks"]]
+        if "chunks" in mx.extra:
+            rates = [c["node_evals_per_sec"] for c in mx.extra["chunks"]]
+        else:  # resident: one device round, rate from the round wall
+            wall_ms = mx.extra.get("round_wall_ms", 0.0)
+            rates = ([mx.node_evals / (wall_ms / 1e3)]
+                     if wall_ms else [])
         chunk_rates += rates
         if level % 8 == 0 or level == bits - 1:
+            p50 = (sorted(rates)[len(rates) // 2] if rates else 0.0)
             stamp(f"level {mx.level}: frontier={mx.frontier_width} "
                   f"accepted={mx.accepted}/{mx.reports_total} "
-                  f"chunk_evals/s p50={sorted(rates)[len(rates)//2]:.0f}")
+                  f"evals/s p50={p50:.0f}")
         level += 1
     agg_wall = time.time() - agg_t0
 
@@ -253,13 +290,17 @@ def main() -> None:
     got = set(hitters)
     mem = run.runner.memory_accounting()
     # Envelope at the FINAL width — a frontier that forced _grow must
-    # be reflected next to the measured accounting.
-    envelope = memory_envelope(bm, C, run.runner.width, R)
+    # be reflected next to the measured accounting.  Resident mode's
+    # "chunk" is the entire batch.
+    envelope = memory_envelope(bm, R if args.resident else C,
+                               run.runner.width, R)
     p50 = sorted(chunk_rates)[len(chunk_rates) // 2]
     out = {
         "inst": args.inst, "platform": platform,
+        "mode": "resident" if args.resident else "chunked",
         "mesh_devices": args.mesh or 1,
-        "reports": R, "bits": bits, "chunk_size": C,
+        "reports": R, "bits": bits,
+        "chunk_size": 0 if args.resident else C,
         "levels": len(run.metrics),
         "threshold": threshold,
         "shard_seconds": round(shard_wall, 1),
