@@ -267,22 +267,30 @@ def main() -> None:
     evals_total = 0
     chunk_rates: list = []
     level = 0
-    while run.step():
-        mx = run.metrics[-1]
-        evals_total += mx.node_evals
-        if "chunks" in mx.extra:
-            rates = [c["node_evals_per_sec"] for c in mx.extra["chunks"]]
-        else:  # resident: one device round, rate from the round wall
-            wall_ms = mx.extra.get("round_wall_ms", 0.0)
-            rates = ([mx.node_evals / (wall_ms / 1e3)]
-                     if wall_ms else [])
-        chunk_rates += rates
-        if level % 8 == 0 or level == bits - 1:
-            p50 = (sorted(rates)[len(rates) // 2] if rates else 0.0)
-            stamp(f"level {mx.level}: frontier={mx.frontier_width} "
-                  f"accepted={mx.accepted}/{mx.reports_total} "
-                  f"evals/s p50={p50:.0f}")
-        level += 1
+    more = True
+    while more:
+        # The deepest level's round runs inside the step() call that
+        # returns False — consume metrics appended since the last
+        # iteration, not just on True returns, or the final level's
+        # evals vanish from the totals.
+        more = run.step()
+        for mx in run.metrics[level:]:
+            evals_total += mx.node_evals
+            if "chunks" in mx.extra:
+                rates = [c["node_evals_per_sec"]
+                         for c in mx.extra["chunks"]]
+            else:  # resident: one device round, rate from its wall
+                wall_ms = mx.extra.get("round_wall_ms", 0.0)
+                rates = ([mx.node_evals / (wall_ms / 1e3)]
+                         if wall_ms else [])
+            chunk_rates += rates
+            if level % 8 == 0 or level == bits - 1 or not more:
+                p50 = (sorted(rates)[len(rates) // 2]
+                       if rates else 0.0)
+                stamp(f"level {mx.level}: frontier={mx.frontier_width}"
+                      f" accepted={mx.accepted}/{mx.reports_total} "
+                      f"evals/s p50={p50:.0f}")
+            level += 1
     agg_wall = time.time() - agg_t0
 
     hitters = run.result()
@@ -294,7 +302,8 @@ def main() -> None:
     # "chunk" is the entire batch.
     envelope = memory_envelope(bm, R if args.resident else C,
                                run.runner.width, R)
-    p50 = sorted(chunk_rates)[len(chunk_rates) // 2]
+    p50 = (sorted(chunk_rates)[len(chunk_rates) // 2]
+           if chunk_rates else 0.0)
     out = {
         "inst": args.inst, "platform": platform,
         "mode": "resident" if args.resident else "chunked",
