@@ -94,7 +94,27 @@ def per_report_bytes(bm: BatchedMastic, width: int) -> dict:
              + 32)                           # helper seed
     if bm.m.flp.JOINT_RAND_LEN > 0:
         store += 32 + 2 * 32                 # leader seed + peer parts
-    return {"carry": carry, "roundkeys": roundkeys, "store": store}
+    # Worst-case binder staging: every carried depth at full width
+    # (real runs prune far below; the per-round gate uses the actual
+    # bucket).
+    cap = 1
+    while cap < bits * width:
+        cap *= 2
+    return {"carry": carry, "roundkeys": roundkeys, "store": store,
+            "binder_peak": _binder_staging_bytes(bm, cap)}
+
+
+def _binder_staging_bytes(bm: BatchedMastic, rows_cap: int) -> int:
+    """Per-report bytes of transient eval-proof binder staging at a
+    given pow2 row bucket — the one cost model shared by the planning
+    envelope (worst-case bucket) and the per-round gate (actual
+    bucket).  An r5 20k × 256 device-resident run OOMed on exactly
+    this term: two 4.92 GiB buffers at bucket 2048 on top of 5.25 GB
+    of carries.  Each bucket slot stages a proof row (32 B) plus a
+    payload row (limb bytes), ×2 aggregators, ×2 for the gather +
+    hash staging copies XLA materializes side by side."""
+    limb_bytes = bm.vidpf.VALUE_LEN * bm.spec.num_limbs * 4
+    return 4 * rows_cap * (32 + limb_bytes)
 
 
 def memory_envelope(bm: BatchedMastic, chunk_size: int, width: int,
@@ -105,6 +125,11 @@ def memory_envelope(bm: BatchedMastic, chunk_size: int, width: int,
     PERF.md §4 walks the arithmetic at the 1M x 256 north star."""
     per = per_report_bytes(bm, width)
     per_chunk = per["carry"] + per["roundkeys"] + per["store"]
+    # Worst-case round peak: resident state + binder staging with
+    # every carried depth at full width.  Informational for planning
+    # (real runs prune far below it) — the gating that protects a run
+    # is per-round at the ACTUAL bucket, check_round_peak below.
+    per_peak = per_chunk + per["binder_peak"]
     device_budget = _device_budget()
     host_budget = _host_budget()
     # Carries and round keys are allocated per padded chunk row (the
@@ -118,6 +143,7 @@ def memory_envelope(bm: BatchedMastic, chunk_size: int, width: int,
         "chunk_size": chunk_size, "num_reports": num_reports,
         "per_report_bytes": per,
         "device_bytes_per_chunk": chunk_size * per_chunk,
+        "device_peak_bytes_per_chunk": chunk_size * per_peak,
         "host_bytes_total": host_total,
         "device_budget_bytes": device_budget,
         "host_budget_bytes": host_budget,
@@ -174,6 +200,47 @@ def check_envelope(bm: BatchedMastic, chunk_size: int, width: int,
             f"per-round aggregate shares cross hosts), or raise "
             f"MASTIC_HOST_BUDGET_BYTES")
     return env
+
+
+def check_round_peak(bm: BatchedMastic, rows_cap: int,
+                     chunk_rows: int, resident_bytes: int,
+                     level: int, n_device_shards: int = 1) -> None:
+    """Per-round device-memory gate at the ACTUAL binder bucket.
+
+    The construction-time envelope bounds resident state; the binder
+    staging buffers scale with the pow2 bucket of the LIVE carried
+    rows, which grows with depth and cannot be known up front without
+    assuming the worst case (which would refuse prunable runs the
+    hardware handles fine).  So both runners call this before each
+    round with the plan's real bucket: a run that would OOM the chip
+    mid-depth instead stops at the offending level with the remedy —
+    and everything up to that level is checkpointable.  (r5: a
+    20k × 256 device-resident run died exactly this way, two 4.92 GiB
+    staging buffers at bucket 2048 surfacing as a remote-compile OOM.)
+    """
+    budget = _device_budget()
+    if budget <= 0:
+        return
+    per_row = _binder_staging_bytes(bm, rows_cap)
+    staging = per_row * chunk_rows
+    peak = -(-(resident_bytes + staging) // n_device_shards)
+    if peak > budget:
+        # Largest TOTAL chunk size (across all its device shards)
+        # whose peak fits: (resident_scaled + per_row*rows)/shards
+        # <= budget, with resident scaling with rows too — bound it
+        # conservatively by keeping resident's per-row share.
+        per_row_resident = resident_bytes // max(1, chunk_rows)
+        max_rows = max(0, (budget * n_device_shards)
+                       // (per_row + per_row_resident))
+        raise ValueError(
+            f"level {level}: binder bucket {rows_cap} needs "
+            f"{staging / 2**30:.1f} GiB of staging on top of "
+            f"{resident_bytes / 2**30:.1f} GiB resident "
+            f"({peak / 2**30:.1f} GiB peak per chip vs budget "
+            f"{budget / 2**30:.1f} GiB) — checkpoint and resume with "
+            f"a total chunk of <= {max_rows} reports (across its "
+            f"{n_device_shards} device shard(s)), shard over more "
+            f"devices, or raise MASTIC_DEVICE_BUDGET_BYTES")
 
 
 class HostReportStore:
@@ -383,6 +450,14 @@ class ChunkedIncrementalRunner(RoundPrograms):
 
         (level, prefixes, do_weight_check) = agg_param
         plan = self._plan(prefixes, level)
+        check_round_peak(
+            self.bm,
+            max(len(plan.onehot_idx), len(plan.payload_parent)),
+            self.store.chunk_size,
+            self.memory_accounting()["device_bytes_per_chunk"],
+            level,
+            (self.mesh.shape["reports"] if self.mesh is not None
+             else self.n_device_shards))
         rnd = round_inputs(plan)
         vk_arr = _vk_array(self.verify_key)
         (eval_fn, agg_fn) = self._fns()

@@ -715,9 +715,17 @@ class _IncrementalRunner(RoundPrograms):
     def round(self, agg_param,
               metrics_out: Optional[list] = None) -> list:
         from ..backend.incremental import round_inputs
+        from .chunked import check_round_peak
 
         (level, prefixes, do_weight_check) = agg_param
         plan = self._plan(prefixes, level)
+        check_round_peak(
+            self.bm,
+            max(len(plan.onehot_idx), len(plan.payload_parent)),
+            self.num_reports,
+            self.memory_accounting()["device_bytes_total"], level,
+            (self.mesh.shape["reports"]
+             if self.mesh is not None else 1))
         (eval_fn, agg_fn) = self._fns()
         (c0, c1, out0, out1, accept, ok) = eval_fn(
             _vk_array(self.verify_key),

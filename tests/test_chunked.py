@@ -265,6 +265,21 @@ def test_memory_envelope_guard(monkeypatch) -> None:
     with pytest.raises(ValueError, match="hosts"):
         HeavyHittersRun(m, CTX, {"default": 1}, None,
                         verify_key=vk, store=store)
+    monkeypatch.delenv("MASTIC_HOST_BUDGET_BYTES")
+
+    # Per-round binder-peak gate (the term a 20k x 256 resident run
+    # OOMed on in r5): construction passes — the envelope cannot know
+    # the live bucket up front — but the round refuses at the actual
+    # bucket with the level named and everything before it
+    # checkpointable.  Applies to both runners; exercised here on the
+    # resident one (its whole batch is the "chunk").
+    run2 = HeavyHittersRun(m, CTX, {"default": 1}, None,
+                           verify_key=vk, batch=batch)
+    resident = run2.runner.memory_accounting()["device_bytes_total"]
+    monkeypatch.setenv("MASTIC_DEVICE_BUDGET_BYTES",
+                       str(resident + 1))
+    with pytest.raises(ValueError, match="binder bucket"):
+        run2.step()
 
 
 def test_shard_device_feeds_chunked_run() -> None:
