@@ -28,8 +28,9 @@ run full python bench.py
 
 # 2. Lever matrix: unroll x pallas on the headline shape (headline-only
 # keeps each cell ~minutes; the full run above already owns last-good,
-# and headline-only cells never overwrite its configs).
-for unroll in 1 8; do
+# and headline-only cells never overwrite its configs).  The default
+# is unroll=1 since r5, so the matrix probes the non-default cells.
+for unroll in 4 8; do
     run "unroll-$unroll" python bench.py --headline-only \
         --keccak-unroll "$unroll"
 done
