@@ -24,14 +24,19 @@ Prints one JSON line:
    "per_chunk_evals_per_sec_p50": ..., "memory": {...},
    "envelope": {...}, "heavy_hitters": [...so many...], "ok": true}
 
-Examples:
-  JAX_PLATFORMS=cpu python tools/northstar.py --reports 20000 --bits 256
+Examples (each shape has a recorded ok=true run, see NORTHSTAR_r05*):
+  JAX_PLATFORMS=cpu python tools/northstar.py --reports 8192 --bits 256
+      # full north-star depth, chunked; ~83 min on a 1-core CPU host
+      # (per-level cost grows with depth - the binder hashes the
+      # carried tree - so 20k reports at 256 bits is ~6 h there)
   JAX_PLATFORMS=cpu python tools/northstar.py --inst sum --reports 10000 \\
-      --bits 32 --max-weight 7
-  python tools/northstar.py --resident --reports 20000 --bits 256
-      # device-resident carries: the fast path on a tunnel-attached
+      --bits 32 --max-weight 255
+  python tools/northstar.py --resident --reports 10000 --bits 256
+      # device-resident carries: the fast path whenever the carry fits
+      # one chip's HBM, and the only fast path on a tunnel-attached
       # chip (chunked mode is transfer-bound there: it moves the full
-      # carry host<->device every level)
+      # carry host<->device every level); 256 levels in ~13 min on a
+      # v5-lite chip
 """
 
 import argparse
