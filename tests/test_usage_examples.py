@@ -1,0 +1,41 @@
+"""The USAGE.md walkthrough snippets, executed.
+
+USAGE.md promises a user of the reference that each of its workflows
+(reference poc/examples.py:37-280) runs here as written; these tests
+keep those snippets from rotting.  Shapes are the doc's own.
+"""
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+from mastic_tpu import MasticCount, MasticSum
+from mastic_tpu.drivers import (aggregate_by_attribute,
+                                compute_heavy_hitters,
+                                get_reports_from_measurements,
+                                hash_attribute)
+from mastic_tpu.oracle import weighted_heavy_hitters
+
+
+def test_usage_plain_heavy_hitters():
+    m = MasticCount(16)
+    meas = [(m.vidpf.test_index_from_int(v, 16), 1)
+            for v in (7, 7, 7, 21, 21, 99)]
+    reports = get_reports_from_measurements(m, b"app", meas)
+    hitters = compute_heavy_hitters(m, b"app", {"default": 2}, reports)
+    expected = {m.vidpf.test_index_from_int(7, 16),
+                m.vidpf.test_index_from_int(21, 16)}
+    assert set(hitters) == expected
+    # The functional oracle agrees (USAGE's ground-truth section).
+    assert set(weighted_heavy_hitters(meas, 2, 16)) == expected
+
+
+def test_usage_attribute_metrics():
+    m = MasticSum(32, 100)
+    meas = [(hash_attribute(m, "checkout.html"), 4),
+            (hash_attribute(m, "landing.html"), 9),
+            (hash_attribute(m, "checkout.html"), 1)]
+    reports = get_reports_from_measurements(m, b"metrics", meas)
+    totals = aggregate_by_attribute(
+        m, b"metrics", ["checkout.html", "landing.html"], reports)
+    assert dict(totals) == {"checkout.html": 5, "landing.html": 9}
