@@ -14,7 +14,12 @@ Checks, over mastic_tpu/, tests/, tools/ and the repo-root scripts:
 5. every annotation in the ANNOTATED layer resolves at runtime
    (typing.get_type_hints over each public function, class and
    method — undefined or misspelled type names fail here even
-   without mypy; mypy itself remains uninstallable in this image).
+   without mypy; mypy itself remains uninstallable in this image);
+6. intra-repo calls to module-level functions match the callee's
+   signature — positional arity, keyword names, required args (the
+   executable subset of mypy's call checking; conservative: bare
+   names only, decorated defs / reassigned names / star-spreads
+   skipped).
 
 Exit status 0 iff clean.  Run via `make lint` / `make ci`.
 """
@@ -184,6 +189,115 @@ def check_annotations_resolve() -> list:
     return problems
 
 
+def _module_name(path: pathlib.Path) -> str:
+    rel = path.relative_to(REPO)
+    return str(rel)[:-3].replace("/", ".")
+
+
+def _collect_defs(tree: ast.Module) -> dict:
+    """Module-level plain functions only (no methods — `self` and
+    inheritance are out of scope; no decorated defs — decorators may
+    change the signature)."""
+    defs = {}
+    for node in tree.body:
+        if isinstance(node, ast.FunctionDef) and not node.decorator_list:
+            defs[node.name] = node.args
+    return defs
+
+
+def _signature_problem(name: str, a: ast.arguments,
+                       call: ast.Call) -> str:
+    """Arity/keyword mismatch text, or '' if the call fits.  Calls
+    spreading *args/**kwargs are the caller's business — skipped."""
+    if any(isinstance(x, ast.Starred) for x in call.args) \
+            or any(k.arg is None for k in call.keywords):
+        return ""
+    pos_params = [p.arg for p in a.posonlyargs + a.args]
+    kw_names = set(pos_params[len(a.posonlyargs):]) \
+        | {p.arg for p in a.kwonlyargs}
+    if a.vararg is None and len(call.args) > len(pos_params):
+        return (f"takes {len(pos_params)} positional arg(s), "
+                f"call passes {len(call.args)}")
+    for k in call.keywords:
+        if k.arg not in kw_names and a.kwarg is None:
+            return f"got unexpected keyword '{k.arg}'"
+    supplied = set(pos_params[:len(call.args)]) \
+        | {k.arg for k in call.keywords}
+    n_defaults = len(a.defaults)
+    required = pos_params[:len(pos_params) - n_defaults]
+    missing = [p for p in required if p not in supplied]
+    if missing:
+        return f"missing required arg(s) {missing}"
+    return ""
+
+
+def check_call_signatures(files: list) -> list:
+    """Check 6: intra-repo calls to module-level functions match the
+    callee's signature (positional arity, keyword names, required
+    args) — the executable subset of mypy's call checking.  Only
+    calls through a bare name that is a same-module def or a
+    `from <repo module> import name`; names locally reassigned and
+    star-spread calls are skipped."""
+    trees = {}
+    for path in files:
+        try:
+            trees[path] = ast.parse(path.read_text())
+        except SyntaxError:
+            continue  # check 1 reports it
+    defs_by_module = {_module_name(p): _collect_defs(t)
+                      for (p, t) in trees.items()}
+
+    problems = []
+    for (path, tree) in trees.items():
+        mod = _module_name(path)
+        pkg_parts = mod.split(".")[:-1]
+        # name -> (defining module, name there)
+        env = {n: (mod, n) for n in defs_by_module.get(mod, {})}
+        reassigned = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom):
+                if node.level:
+                    base = pkg_parts[:len(pkg_parts) - node.level + 1]
+                    target = ".".join(base + ([node.module]
+                                              if node.module else []))
+                else:
+                    target = node.module or ""
+                if target in defs_by_module:
+                    for alias in node.names:
+                        if alias.name in defs_by_module[target]:
+                            env[alias.asname or alias.name] = \
+                                (target, alias.name)
+            elif isinstance(node, (ast.Assign, ast.AugAssign,
+                                   ast.AnnAssign, ast.For)):
+                targets = (node.targets
+                           if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            reassigned.add(n.id)
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                for arg in (node.args.posonlyargs + node.args.args
+                            + node.args.kwonlyargs):
+                    reassigned.add(arg.arg)
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)):
+                continue
+            name = node.func.id
+            if name in reassigned or name not in env:
+                continue
+            (dmod, dname) = env[name]
+            msg = _signature_problem(
+                name, defs_by_module[dmod][dname], node)
+            if msg:
+                problems.append(
+                    f"{path.relative_to(REPO)}:{node.lineno}: call to "
+                    f"{dmod}.{dname} {msg}")
+    return problems
+
+
 def main() -> int:
     roots = [REPO / "mastic_tpu", REPO / "tests", REPO / "tools"]
     files = [REPO / "bench.py", REPO / "__graft_entry__.py"]
@@ -193,6 +307,7 @@ def main() -> int:
     for path in files:
         problems += check_file(path)
     problems += check_annotations_resolve()
+    problems += check_call_signatures(files)
     for problem in problems:
         print(problem)
     print(f"lint: {len(files)} files, {len(problems)} problem(s)")
