@@ -108,6 +108,18 @@ def main() -> None:
                              "the platform is cpu)")
     parser.add_argument("--out", type=str, default=None,
                         help="also write the JSON artifact here")
+    parser.add_argument("--checkpoint", type=str, default=None,
+                        help="write the run state here every "
+                             "--checkpoint-every levels (verify key "
+                             "+ HeavyHittersRun.to_bytes, atomic "
+                             "rename); with --resume, restore from "
+                             "it and continue")
+    parser.add_argument("--checkpoint-every", type=int, default=16)
+    parser.add_argument("--resume", action="store_true",
+                        help="resume from --checkpoint instead of "
+                             "starting fresh (reports are rebuilt "
+                             "deterministically from --seed, so only "
+                             "the run state needs the file)")
     args = parser.parse_args()
 
     if args.mesh:
@@ -248,24 +260,61 @@ def main() -> None:
     stamp(f"shard: {R} reports in {shard_wall:.1f}s "
           f"({R / shard_wall:.0f} reports/s)")
 
-    vk = gen_rand(m.VERIFY_KEY_SIZE)
     mesh = None
     if args.mesh:
         from mastic_tpu.parallel import make_mesh
         mesh = make_mesh(args.mesh, nodes_axis=1)
         stamp(f"mesh: report axis sharded over {args.mesh} devices")
+
+    # Checkpoint file = 2-byte vk length + vk + HeavyHittersRun blob.
+    # The vk rides along because the blob's binding digest pins it
+    # (a fresh key would silently reject every carried report).
+    resumed_from = None
+    ckpt_blob = None
+    if args.resume:
+        if not args.checkpoint:
+            parser.error("--resume needs --checkpoint PATH")
+        with open(args.checkpoint, "rb") as f:
+            raw = f.read()
+        klen = int.from_bytes(raw[:2], "little")
+        vk = raw[2:2 + klen]
+        ckpt_blob = raw[2 + klen:]
+    else:
+        vk = gen_rand(m.VERIFY_KEY_SIZE)
+
+    thresholds = {"default": threshold}
     if args.resident:
         full_batch = jax.tree_util.tree_map(
             lambda *xs: jnp.concatenate(xs, axis=0), *chunk_batches)
         chunk_batches.clear()  # don't hold 2x the batch in HBM
-        run = HeavyHittersRun(m, b"northstar", {"default": threshold},
-                              None, verify_key=vk, batch=full_batch,
-                              mesh=mesh)
+        if ckpt_blob is not None:
+            run = HeavyHittersRun.from_bytes(
+                m, b"northstar", thresholds, None, vk, ckpt_blob,
+                batch=full_batch, mesh=mesh)
+        else:
+            run = HeavyHittersRun(m, b"northstar", thresholds,
+                                  None, verify_key=vk,
+                                  batch=full_batch, mesh=mesh)
     else:
         store = HostReportStore(arrays, R, C)
-        run = HeavyHittersRun(m, b"northstar", {"default": threshold},
-                              None, verify_key=vk, store=store,
-                              mesh=mesh)
+        if ckpt_blob is not None:
+            run = HeavyHittersRun.from_bytes(
+                m, b"northstar", thresholds, None, vk, ckpt_blob,
+                store=store, mesh=mesh)
+        else:
+            run = HeavyHittersRun(m, b"northstar", thresholds,
+                                  None, verify_key=vk, store=store,
+                                  mesh=mesh)
+    if ckpt_blob is not None:
+        resumed_from = run.level
+        stamp(f"resumed from checkpoint at level {run.level}")
+
+    def save_checkpoint() -> None:
+        tmp = args.checkpoint + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(len(vk).to_bytes(2, "little") + vk
+                    + run.to_bytes())
+        os.replace(tmp, args.checkpoint)
 
     stamp(f"rounds: threshold={threshold} planted={args.planted}")
     agg_t0 = time.time()
@@ -279,6 +328,10 @@ def main() -> None:
         # iteration, not just on True returns, or the final level's
         # evals vanish from the totals.
         more = run.step()
+        if args.checkpoint and more \
+                and run.level % args.checkpoint_every == 0:
+            save_checkpoint()
+            stamp(f"checkpoint written at level {run.level}")
         for mx in run.metrics[level:]:
             evals_total += mx.node_evals
             if "chunks" in mx.extra:
@@ -330,6 +383,9 @@ def main() -> None:
     }
     if args.inst == "sum":
         out["max_weight"] = args.max_weight
+    if resumed_from is not None:
+        # wall/evals cover only this process's rounds.
+        out["resumed_from_level"] = resumed_from
     line = json.dumps(out)
     print(line, flush=True)
     if args.out:
