@@ -311,6 +311,21 @@ class SteadyState:
                                    self.binder).compile()
         dt = time.perf_counter() - t0
         self.step = compiled
+        # XLA's compiled cost analysis: logical bytes accessed per
+        # step — the roofline-position number PERF.md §3 tracks (the
+        # megakernel's acceptance metric is this value dropping >= 3x
+        # vs the scan path on the same platform).  Fail-open: some
+        # backends return nothing.
+        self.cost_bytes = None
+        try:
+            ca = compiled.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            val = ca.get("bytes accessed")
+            if val is not None:
+                self.cost_bytes = float(val)
+        except Exception:
+            pass
         return dt
 
     def run(self, steps: int) -> float:
@@ -565,6 +580,12 @@ def main():
                         help="route the Keccak permutation through "
                         "the Pallas fused-VMEM kernel "
                         "(MASTIC_KECCAK_PALLAS)")
+    parser.add_argument("--level-pallas", action="store_true",
+                        help="route the whole level step (extend -> "
+                        "correct -> convert -> node proof) through "
+                        "the fused-VMEM Pallas megakernel "
+                        "(MASTIC_LEVEL_PALLAS) — the HBM-roofline "
+                        "lever, PERF.md §3")
     parser.add_argument("--watchdog", type=float, default=1500.0)
     parser.add_argument("--attach-timeout", type=float, default=60.0)
     parser.add_argument("--attach-retries", type=int, default=3)
@@ -586,6 +607,8 @@ def main():
         os.environ["MASTIC_KECCAK_PALLAS"] = "1"
     if args.aes_pallas:
         os.environ["MASTIC_AES_PALLAS"] = "1"
+    if args.level_pallas:
+        os.environ["MASTIC_LEVEL_PALLAS"] = "1"
 
     # Pre-seed the fail-open record from the last verified run BEFORE
     # anything that can hang, so every exit path has a nonzero number
@@ -693,6 +716,15 @@ def main():
         os.environ.get("MASTIC_KECCAK_PALLAS", "0") == "1"
     PARTIAL["aes_pallas"] = \
         os.environ.get("MASTIC_AES_PALLAS", "0") == "1"
+    PARTIAL["level_pallas"] = \
+        os.environ.get("MASTIC_LEVEL_PALLAS", "0") == "1"
+    if full.cost_bytes:
+        # Logical bytes accessed per step / per eval (PERF.md §3: the
+        # scan path measured 8.29 GB/step = 15.8 KB/eval on a v5e;
+        # the megakernel acceptance target is < 5.3 KB/eval).
+        PARTIAL["cost_bytes_per_step"] = round(full.cost_bytes, 1)
+        PARTIAL["cost_bytes_per_eval"] = round(
+            full.cost_bytes / full.evals_per_step, 1)
 
     if not args.headline_only:
         try:

@@ -18,6 +18,7 @@ the VIDPF only ever adds/subtracts payloads, so no domain conversion
 is needed until the FLP (which multiplies) takes over.
 """
 
+import os
 from typing import NamedTuple
 
 import jax
@@ -40,6 +41,15 @@ from .xof_jax import (fixed_key_blocks, fixed_key_blocks_planes,
 _U8 = jnp.uint8
 
 KEY_SIZE = 16
+
+# Third backend path: route the whole level step (extend -> correct ->
+# convert -> node proof) through the fused-VMEM Pallas megakernel
+# (ops/level_pallas.py) instead of chaining scan-path stages.  Read
+# once at import like the per-stage levers (MASTIC_KECCAK_PALLAS /
+# MASTIC_AES_PALLAS in ops/); interpret mode is selected per call from
+# the active backend so the CPU fabric exercises the kernel path
+# bit-exactly via chained per-stage calls.
+USE_LEVEL_PALLAS = os.environ.get("MASTIC_LEVEL_PALLAS", "0") == "1"
 
 
 class BatchedCorrectionWords(NamedTuple):
@@ -370,9 +380,30 @@ class BatchedVidpf:
                   node_binder: np.ndarray):
         """One level of the tree: extend every parent, correct, convert
         and hash both children (see level_core).  Returns (EvalState
-        for the children, ok (R,))."""
+        for the children, ok (R,)).
+
+        With MASTIC_LEVEL_PALLAS=1 and a supported shape, the whole
+        level runs in the fused-VMEM megakernel (ops/level_pallas.py):
+        same byte-exact outputs, but the per-eval intermediates never
+        round-trip HBM (PERF.md §3's roofline lever).  Unsupported
+        shapes (tiny batches, huge-payload converts, binders past one
+        sponge block) keep the scan path."""
         (_seed_cw, _ctrl_cw, _w_cw, proof_cw) = cw_slice
         (num_reports, num_parents) = parents.ctrl.shape
+
+        if USE_LEVEL_PALLAS and num_reports >= 32:
+            from ..ops.level_pallas import supports
+            prefix = ts_prefix(dst(ctx, USAGE_NODE_PROOF), KEY_SIZE)
+            binder = np.asarray(node_binder) \
+                if isinstance(node_binder, np.ndarray) else node_binder
+            if supports(self.convert_blocks, len(prefix),
+                        int(binder.shape[-1])):
+                (child, ok) = self._eval_step_level_pallas(
+                    ext_rk, conv_rk, parents, cw_slice, prefix, binder)
+                if self.constrain_state is not None:
+                    child = self.constrain_state(child)
+                return (child, ok)
+
         (next_seed, ct, w, ok) = self.level_core(ext_rk, conv_rk,
                                                  parents, cw_slice)
 
@@ -385,6 +416,25 @@ class BatchedVidpf:
         child = EvalState(seed=next_seed, ctrl=ct, w=w, proof=proof)
         if self.constrain_state is not None:
             child = self.constrain_state(child)
+        return (child, jnp.all(ok, axis=-1))
+
+    def _eval_step_level_pallas(self, ext_rk: jax.Array,
+                                conv_rk: jax.Array,
+                                parents: EvalState, cw_slice,
+                                prefix: bytes, node_binder):
+        """The megakernel level step (ops/level_pallas.py): one fused
+        VMEM-resident kernel on hardware, chained per-stage kernel
+        calls on the CPU fabric (the r5 interpret-validation
+        technique)."""
+        from ..ops.level_pallas import level_step_pallas
+
+        (seed_cw, ctrl_cw, w_cw, proof_cw) = cw_slice
+        (next_seed, ct, w, ok, proof) = level_step_pallas(
+            self.spec, self.convert_blocks, ext_rk, conv_rk,
+            parents.seed, parents.ctrl,
+            (seed_cw, ctrl_cw, w_cw, proof_cw), prefix, node_binder,
+            interpret=jax.default_backend() == "cpu")
+        child = EvalState(seed=next_seed, ctrl=ct, w=w, proof=proof)
         return (child, jnp.all(ok, axis=-1))
 
     def eval_full(self, agg_id: int, cws: BatchedCorrectionWords,

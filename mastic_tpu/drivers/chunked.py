@@ -96,25 +96,32 @@ def per_report_bytes(bm: BatchedMastic, width: int) -> dict:
         store += 32 + 2 * 32                 # leader seed + peer parts
     # Worst-case binder staging: every carried depth at full width
     # (real runs prune far below; the per-round gate uses the actual
-    # bucket).
+    # buckets).
     cap = 1
     while cap < bits * width:
         cap *= 2
     return {"carry": carry, "roundkeys": roundkeys, "store": store,
-            "binder_peak": _binder_staging_bytes(bm, cap)}
+            "binder_peak": _binder_staging_bytes(bm, cap, cap)}
 
 
-def _binder_staging_bytes(bm: BatchedMastic, rows_cap: int) -> int:
-    """Per-report bytes of transient eval-proof binder staging at a
-    given pow2 row bucket — the one cost model shared by the planning
-    envelope (worst-case bucket) and the per-round gate (actual
-    bucket).  An r5 20k × 256 device-resident run OOMed on exactly
-    this term: two 4.92 GiB buffers at bucket 2048 on top of 5.25 GB
-    of carries.  Each bucket slot stages a proof row (32 B) plus a
-    payload row (limb bytes), ×2 aggregators, ×2 for the gather +
-    hash staging copies XLA materializes side by side."""
+def _binder_staging_bytes(bm: BatchedMastic, onehot_cap: int,
+                          payload_cap: int) -> int:
+    """Per-report bytes of transient eval-proof binder staging — the
+    one cost model shared by the planning envelope (worst-case
+    buckets) and the per-round gate (actual buckets).  An r5
+    20k × 256 device-resident run OOMed on exactly this term: two
+    4.92 GiB buffers at bucket 2048 on top of 5.25 GB of carries.
+
+    The onehot check stages a 32-byte proof row per slot of ITS pow2
+    bucket and the payload check a limb row per slot of ITS bucket —
+    the two buckets diverge whenever the payload row count (internal
+    ancestors) trails the onehot row count (all current children), so
+    each term is priced at its own bucket and summed (ADVICE r5: a
+    shared max() cap overstated the peak and refused runs that fit).
+    ×2 aggregators, ×2 for the gather + hash staging copies XLA
+    materializes side by side."""
     limb_bytes = bm.vidpf.VALUE_LEN * bm.spec.num_limbs * 4
-    return 4 * rows_cap * (32 + limb_bytes)
+    return 4 * (onehot_cap * 32 + payload_cap * limb_bytes)
 
 
 def memory_envelope(bm: BatchedMastic, chunk_size: int, width: int,
@@ -202,26 +209,29 @@ def check_envelope(bm: BatchedMastic, chunk_size: int, width: int,
     return env
 
 
-def check_round_peak(bm: BatchedMastic, rows_cap: int,
-                     chunk_rows: int, resident_bytes: int,
-                     level: int, n_device_shards: int = 1) -> None:
-    """Per-round device-memory gate at the ACTUAL binder bucket.
+def check_round_peak(bm: BatchedMastic, onehot_cap: int,
+                     payload_cap: int, chunk_rows: int,
+                     resident_bytes: int, level: int,
+                     n_device_shards: int = 1) -> None:
+    """Per-round device-memory gate at the ACTUAL binder buckets.
 
     The construction-time envelope bounds resident state; the binder
-    staging buffers scale with the pow2 bucket of the LIVE carried
-    rows, which grows with depth and cannot be known up front without
+    staging buffers scale with the pow2 buckets of the LIVE carried
+    rows, which grow with depth and cannot be known up front without
     assuming the worst case (which would refuse prunable runs the
     hardware handles fine).  So both runners call this before each
-    round with the plan's real bucket: a run that would OOM the chip
-    mid-depth instead stops at the offending level with the remedy —
-    and everything up to that level is checkpointable.  (r5: a
-    20k × 256 device-resident run died exactly this way, two 4.92 GiB
-    staging buffers at bucket 2048 surfacing as a remote-compile OOM.)
+    round with the plan's real buckets — proof staging priced at the
+    onehot bucket, payload staging at the (usually smaller) payload
+    bucket: a run that would OOM the chip mid-depth instead stops at
+    the offending level with the remedy, and everything up to that
+    level is checkpointable.  (r5: a 20k × 256 device-resident run
+    died exactly this way, two 4.92 GiB staging buffers at bucket
+    2048 surfacing as a remote-compile OOM.)
     """
     budget = _device_budget()
     if budget <= 0:
         return
-    per_row = _binder_staging_bytes(bm, rows_cap)
+    per_row = _binder_staging_bytes(bm, onehot_cap, payload_cap)
     staging = per_row * chunk_rows
     peak = -(-(resident_bytes + staging) // n_device_shards)
     if peak > budget:
@@ -233,7 +243,8 @@ def check_round_peak(bm: BatchedMastic, rows_cap: int,
         max_rows = max(0, (budget * n_device_shards)
                        // (per_row + per_row_resident))
         raise ValueError(
-            f"level {level}: binder bucket {rows_cap} needs "
+            f"level {level}: binder buckets {onehot_cap} (onehot) / "
+            f"{payload_cap} (payload) need "
             f"{staging / 2**30:.1f} GiB of staging on top of "
             f"{resident_bytes / 2**30:.1f} GiB resident "
             f"({peak / 2**30:.1f} GiB peak per chip vs budget "
@@ -452,7 +463,7 @@ class ChunkedIncrementalRunner(RoundPrograms):
         plan = self._plan(prefixes, level)
         check_round_peak(
             self.bm,
-            max(len(plan.onehot_idx), len(plan.payload_parent)),
+            len(plan.onehot_idx), len(plan.payload_parent),
             self.store.chunk_size,
             self.memory_accounting()["device_bytes_per_chunk"],
             level,

@@ -721,7 +721,7 @@ class _IncrementalRunner(RoundPrograms):
         plan = self._plan(prefixes, level)
         check_round_peak(
             self.bm,
-            max(len(plan.onehot_idx), len(plan.payload_parent)),
+            len(plan.onehot_idx), len(plan.payload_parent),
             self.num_reports,
             self.memory_accounting()["device_bytes_total"], level,
             (self.mesh.shape["reports"]

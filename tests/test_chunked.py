@@ -269,8 +269,8 @@ def test_memory_envelope_guard(monkeypatch) -> None:
 
     # Per-round binder-peak gate (the term a 20k x 256 resident run
     # OOMed on in r5): construction passes — the envelope cannot know
-    # the live bucket up front — but the round refuses at the actual
-    # bucket with the level named and everything before it
+    # the live buckets up front — but the round refuses at the actual
+    # buckets with the level named and everything before it
     # checkpointable.  Applies to both runners; exercised here on the
     # resident one (its whole batch is the "chunk").
     run2 = HeavyHittersRun(m, CTX, {"default": 1}, None,
@@ -278,8 +278,46 @@ def test_memory_envelope_guard(monkeypatch) -> None:
     resident = run2.runner.memory_accounting()["device_bytes_total"]
     monkeypatch.setenv("MASTIC_DEVICE_BUDGET_BYTES",
                        str(resident + 1))
-    with pytest.raises(ValueError, match="binder bucket"):
+    with pytest.raises(ValueError, match="binder buckets"):
         run2.step()
+
+
+def test_round_peak_per_bucket_model(monkeypatch) -> None:
+    """check_round_peak prices the proof staging at the onehot bucket
+    and the payload staging at the payload bucket, SUMMED — not
+    max(onehot, payload) applied to both (ADVICE r5: the shared cap
+    overstated the peak whenever the two pow2 buckets diverge, which
+    is the common case — payload rows trail onehot rows — and
+    refused runs that actually fit the budget)."""
+    from mastic_tpu.drivers.chunked import (_binder_staging_bytes,
+                                            check_round_peak)
+
+    m = MasticCount(8)
+    bm = BatchedMastic(m)
+    limb_bytes = m.vidpf.VALUE_LEN * bm.spec.num_limbs * 4
+    (onehot_cap, payload_cap, rows, resident) = (64, 16, 100, 1 << 20)
+
+    per_row = _binder_staging_bytes(bm, onehot_cap, payload_cap)
+    assert per_row == 4 * (onehot_cap * 32 + payload_cap * limb_bytes)
+    old_model = 4 * max(onehot_cap, payload_cap) * (32 + limb_bytes)
+    assert per_row < old_model  # diverging buckets: model tightened
+
+    # A budget between the tightened peak and the old overstated one:
+    # the old model refused this shape; the per-bucket model admits it.
+    peak = resident + per_row * rows
+    monkeypatch.setenv(
+        "MASTIC_DEVICE_BUDGET_BYTES",
+        str((resident + old_model * rows + peak) // 2))
+    check_round_peak(bm, onehot_cap, payload_cap, rows, resident, 3)
+
+    # Still a real gate: a budget below the tightened peak refuses,
+    # naming both buckets and the level.
+    monkeypatch.setenv("MASTIC_DEVICE_BUDGET_BYTES", str(peak - 1))
+    with pytest.raises(ValueError) as err:
+        check_round_peak(bm, onehot_cap, payload_cap, rows, resident, 3)
+    assert "64 (onehot)" in str(err.value)
+    assert "16 (payload)" in str(err.value)
+    assert "level 3" in str(err.value)
 
 
 def test_shard_device_feeds_chunked_run() -> None:

@@ -1,0 +1,88 @@
+"""tools/northstar.py CLI safety (ADVICE r5 regressions): argument
+validation at parse time and the resume shard-parameter binding."""
+
+import importlib.util
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NORTHSTAR = os.path.join(REPO, "tools", "northstar.py")
+
+
+def _load_tool():
+    spec = importlib.util.spec_from_file_location("northstar_tool",
+                                                  NORTHSTAR)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_checkpoint_every_rejected_at_parse_time():
+    """--checkpoint-every < 1 must die in argument parsing (exit 2,
+    before any JAX import or sharding work), not as a
+    ZeroDivisionError after the first completed level."""
+    for bad in ("0", "-3"):
+        proc = subprocess.run(
+            [sys.executable, NORTHSTAR, "--checkpoint-every", bad],
+            capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 2, proc.stderr
+        assert "--checkpoint-every" in proc.stderr
+        assert "ZeroDivision" not in proc.stderr
+
+
+def test_checkpoint_header_roundtrip():
+    tool = _load_tool()
+    vk = bytes(range(32))
+    params = {"inst": "count", "reports": 100, "bits": 16, "seed": 7,
+              "planted": 3, "max_weight": 7, "tail_weight": 1}
+    blob = b"run state bytes"
+    raw = tool.write_checkpoint_bytes(vk, params, blob)
+    (vk2, params2, blob2) = tool.read_checkpoint_bytes(raw)
+    assert (vk2, params2, blob2) == (vk, params, blob)
+    assert tool.verify_shard_params(params2, params) == []
+
+
+def test_checkpoint_header_mismatch_detected():
+    """A resume with different shard parameters must be detectable
+    immediately — each differing key named (the old format silently
+    continued carried state over mismatched reports and only failed
+    at the end of the full remaining wall time)."""
+    tool = _load_tool()
+    saved = {"inst": "count", "reports": 100, "bits": 16, "seed": 7,
+             "planted": 3, "max_weight": 7, "tail_weight": 1}
+    current = dict(saved, seed=8, planted=2)
+    assert tool.verify_shard_params(saved, current) == \
+        ["planted", "seed"]
+
+
+def test_checkpoint_old_format_refused():
+    """A pre-header checkpoint (vk + blob only) must fail with a
+    descriptive error, not be misread as carried state."""
+    tool = _load_tool()
+    vk = bytes(range(32))
+    raw = len(vk).to_bytes(2, "little") + vk + b"\x00" * 64
+    with pytest.raises(ValueError, match="header"):
+        tool.read_checkpoint_bytes(raw)
+
+
+def test_resume_param_mismatch_exits_before_rounds(tmp_path):
+    """End to end through the CLI: write a checkpoint at one --seed,
+    resume at another — the process must refuse at startup (exit 2,
+    naming the parameter), never reaching the aggregation rounds."""
+    tool = _load_tool()
+    ck = tmp_path / "run.ck"
+    params = {"inst": "count", "reports": 64, "bits": 4, "seed": 1,
+              "planted": 2, "max_weight": 7, "tail_weight": 1}
+    ck.write_bytes(tool.write_checkpoint_bytes(
+        bytes(range(32)), params, b""))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, NORTHSTAR, "--reports", "64", "--bits", "4",
+         "--planted", "2", "--seed", "2", "--checkpoint", str(ck),
+         "--resume"],
+        capture_output=True, text=True, timeout=570, env=env)
+    assert proc.returncode == 2, (proc.stdout, proc.stderr)
+    assert "seed" in proc.stderr and "--resume refused" in proc.stderr

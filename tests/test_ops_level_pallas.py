@@ -1,0 +1,154 @@
+"""Differential tests: the fused level-step megakernel
+(ops/level_pallas.py) vs the scan-path eval_step.
+
+The chained form runs ONE pallas kernel per pipeline stage (39
+stages: extend sigma, 11 extend-AES stages, correct, 11 convert-AES
+stages, convert finish, absorb, 12 Keccak rounds, squeeze), so a
+passing run pins every AES round key, every Keccak round constant and
+the final AES round's missing MixColumns individually — the r5
+technique that avoids the fused form's >1 h interpret compile."""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax.experimental.pallas")
+
+import jax.numpy as jnp
+
+from mastic_tpu.backend.vidpf_jax import BatchedVidpf, EvalState
+from mastic_tpu.backend.xof_jax import ts_prefix
+from mastic_tpu.dst import USAGE_NODE_PROOF, dst
+from mastic_tpu.field import Field64, Field128
+
+CTX = b"level kernel test"
+KEY_SIZE = 16
+
+
+def _level_inputs(vid, num_reports, num_parents, seed=1):
+    """Random parent state + correction-word slice + per-level binder
+    for one eval_step at (num_reports x num_parents)."""
+    rng = np.random.default_rng(seed)
+    n = vid.spec.num_limbs
+    vl = vid.VALUE_LEN
+    nonces = jnp.asarray(rng.integers(0, 256, (num_reports, 16),
+                                      np.uint8))
+    (ext_rk, conv_rk) = vid.roundkeys(CTX, nonces)
+    parents = EvalState(
+        seed=jnp.asarray(rng.integers(
+            0, 256, (num_reports, num_parents, 16), np.uint8)),
+        ctrl=jnp.asarray(rng.integers(
+            0, 2, (num_reports, num_parents)).astype(bool)),
+        w=jnp.zeros((num_reports, num_parents, vl, n), jnp.uint32),
+        proof=jnp.zeros((num_reports, num_parents, 32), jnp.uint8))
+    cw = (jnp.asarray(rng.integers(0, 256, (num_reports, 16),
+                                   np.uint8)),
+          jnp.asarray(rng.integers(0, 2, (num_reports, 2))
+                      .astype(bool)),
+          jnp.asarray(rng.integers(0, 1 << 16, (num_reports, vl, n),
+                                   dtype=np.uint32)),
+          jnp.asarray(rng.integers(0, 256, (num_reports, 32),
+                                   np.uint8)))
+    binder = rng.integers(
+        0, 256, (2 * num_parents, 4 + (vid.BITS + 7) // 8), np.uint8)
+    return (ext_rk, conv_rk, parents, cw, binder)
+
+
+def _assert_matches_eval_step(vid, num_reports, num_parents, seed=1):
+    from mastic_tpu.ops.level_pallas import level_step_pallas
+
+    (ext_rk, conv_rk, parents, cw, binder) = _level_inputs(
+        vid, num_reports, num_parents, seed)
+    (child, ok) = vid.eval_step(ext_rk, conv_rk, parents, cw, CTX,
+                                binder)
+    prefix = ts_prefix(dst(CTX, USAGE_NODE_PROOF), KEY_SIZE)
+    (seed_b, ct, w, ok_k, proof) = level_step_pallas(
+        vid.spec, vid.convert_blocks, ext_rk, conv_rk, parents.seed,
+        parents.ctrl, cw, prefix, binder, interpret=True)
+    np.testing.assert_array_equal(np.asarray(seed_b),
+                                  np.asarray(child.seed))
+    np.testing.assert_array_equal(np.asarray(ct),
+                                  np.asarray(child.ctrl))
+    np.testing.assert_array_equal(np.asarray(w), np.asarray(child.w))
+    np.testing.assert_array_equal(np.asarray(proof),
+                                  np.asarray(child.proof))
+    np.testing.assert_array_equal(
+        np.asarray(jnp.all(ok_k, axis=-1)), np.asarray(ok))
+
+
+def test_level_pallas_matches_eval_step():
+    """Field64 (MasticCount shape, convert_blocks=2) at a small tile:
+    all 39 chained stages bit-exact vs the scan path."""
+    _assert_matches_eval_step(BatchedVidpf(Field64, 16, 2), 64, 4)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("field,vl,reports,parents,bits", [
+    (Field64, 2, 40, 2, 256),   # frontier < 8, reports % 32 != 0
+    (Field64, 2, 33, 1, 8),     # single parent, odd report count
+    (Field128, 2, 64, 3, 64),   # convert_blocks=3 -> 4-parent blocks
+    (Field128, 1, 32, 5, 32),   # Field128 narrow payload
+], ids=["f64-edge", "f64-single", "f128-m3", "f128-vl1"])
+def test_level_pallas_edge_shapes(field, vl, reports, parents, bits):
+    """Padding paths: report lanes below/off the 32-packing, parent
+    counts below/off the grid block, Field64 vs Field128 payload
+    widths and an odd convert-block count."""
+    _assert_matches_eval_step(BatchedVidpf(field, bits, vl),
+                              reports, parents, seed=2)
+
+
+@pytest.mark.slow
+def test_level_pallas_headline_tile():
+    """The headline steady-state tile (4096 reports x 64 frontier,
+    256-bit tree): the shape the chip session measures, bit-exact in
+    interpret mode on the CPU fabric (acceptance criterion)."""
+    _assert_matches_eval_step(BatchedVidpf(Field64, 256, 2), 4096, 64,
+                              seed=3)
+
+
+def test_eval_step_gates_to_megakernel(monkeypatch):
+    """The MASTIC_LEVEL_PALLAS backend path: eval_step routes
+    supported shapes through the megakernel (same outputs as the
+    scan path) and keeps the scan path for unsupported ones."""
+    from mastic_tpu.backend import vidpf_jax
+    from mastic_tpu.ops import level_pallas
+
+    vid = BatchedVidpf(Field64, 16, 2)
+    (ext_rk, conv_rk, parents, cw, binder) = _level_inputs(vid, 32, 2)
+    (want_child, want_ok) = vid.eval_step(ext_rk, conv_rk, parents,
+                                          cw, CTX, binder)
+
+    calls = []
+    orig = level_pallas.level_step_pallas
+
+    def spy(*args, **kwargs):
+        calls.append(1)
+        return orig(*args, **kwargs)
+
+    monkeypatch.setattr(level_pallas, "level_step_pallas", spy)
+    monkeypatch.setattr(vidpf_jax, "USE_LEVEL_PALLAS", True)
+    (child, ok) = vid.eval_step(ext_rk, conv_rk, parents, cw, CTX,
+                                binder)
+    assert calls, "supported shape must take the megakernel path"
+    for (got, want) in zip(child, want_child):
+        np.testing.assert_array_equal(np.asarray(got),
+                                      np.asarray(want))
+    np.testing.assert_array_equal(np.asarray(ok), np.asarray(want_ok))
+
+    # Tiny batches (< 32 reports) and huge-payload converts fall back
+    # to the scan path rather than fail.
+    calls.clear()
+    (ext_rk2, conv_rk2, parents2, cw2, binder2) = _level_inputs(
+        vid, 8, 2)
+    (child2, ok2) = vid.eval_step(ext_rk2, conv_rk2, parents2, cw2,
+                                  CTX, binder2)
+    assert not calls, "tiny batch must keep the scan path"
+    assert child2.seed.shape == (8, 4, 16)
+
+    big = BatchedVidpf(Field128, 16, 40)   # convert_blocks > 8
+    assert not level_pallas.supports(big.convert_blocks, 20, 6)
+    (ext_rk3, conv_rk3, parents3, cw3, binder3) = _level_inputs(
+        big, 32, 2)
+    (child3, _ok3) = big.eval_step(ext_rk3, conv_rk3, parents3, cw3,
+                                   CTX, binder3)
+    assert not calls, "huge-payload convert must keep the scan path"
+    assert child3.seed.shape == (32, 4, 16)

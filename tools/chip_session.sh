@@ -37,6 +37,13 @@ done
 run pallas python bench.py --headline-only --keccak-pallas
 run aes-pallas python bench.py --headline-only --aes-pallas
 
+# 3b. The fused level-step megakernel (ops/level_pallas.py): first
+# hardware execution of the whole extend->correct->convert->proof
+# pipeline in VMEM — the HBM-roofline lever (PERF.md §3).  The JSON
+# line carries cost_bytes_per_eval, the acceptance metric (< 5.3 KB
+# vs the scan path's measured 15.8 KB).
+run level-pallas python bench.py --headline-only --level-pallas
+
 # Every on-chip run persists itself to BENCH_LAST_GOOD; end on the
 # default configuration so the cached record reflects the default
 # levers, not whichever matrix cell happened to run last.

@@ -19,13 +19,20 @@ Checks, over mastic_tpu/, tests/, tools/ and the repo-root scripts:
    signature — positional arity, keyword names, required args (the
    executable subset of mypy's call checking; conservative: bare
    names only, decorated defs / reassigned names / star-spreads
-   skipped).
+   skipped);
+7. every MASTIC_* env lever referenced in mastic_tpu/ or bench.py is
+   documented in USAGE.md, and every kernel/backend lever (read in
+   mastic_tpu/ops/ or mastic_tpu/backend/) is exercised by
+   tools/chip_session.sh — either by env name or by its bench.py
+   flag form (--foo-bar for MASTIC_FOO_BAR).  Prevents the r5 class
+   of "kernel exists but no session script exercises it".
 
 Exit status 0 iff clean.  Run via `make lint` / `make ci`.
 """
 
 import ast
 import pathlib
+import re
 import sys
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
@@ -298,6 +305,44 @@ def check_call_signatures(files: list) -> list:
     return problems
 
 
+_LEVER_RE = re.compile(r"MASTIC_[A-Z][A-Z0-9_]*")
+
+
+def check_env_levers() -> list:
+    """Check 7: lever coverage.  A MASTIC_* env var referenced
+    anywhere in mastic_tpu/ or bench.py must be documented in
+    USAGE.md; one referenced in the kernel/backend layer (ops/ or
+    backend/ — the compute-path levers a chip session must measure)
+    must additionally appear in tools/chip_session.sh, either
+    verbatim or as the bench.py flag it maps to."""
+    lever_files = sorted((REPO / "mastic_tpu").rglob("*.py"))
+    lever_files.append(REPO / "bench.py")
+    levers: dict = {}          # name -> (first file, is_kernel_lever)
+    for path in lever_files:
+        rel = str(path.relative_to(REPO))
+        kernel = rel.startswith(("mastic_tpu/ops/",
+                                 "mastic_tpu/backend/"))
+        for name in _LEVER_RE.findall(path.read_text()):
+            (seen_rel, seen_kernel) = levers.get(name, (rel, False))
+            levers[name] = (seen_rel, seen_kernel or kernel)
+
+    usage = (REPO / "USAGE.md").read_text()
+    session = (REPO / "tools" / "chip_session.sh").read_text()
+    problems = []
+    for (name, (rel, kernel)) in sorted(levers.items()):
+        if name not in usage:
+            problems.append(
+                f"{rel}: env lever {name} is not documented in "
+                f"USAGE.md")
+        flag = "--" + name[len("MASTIC_"):].lower().replace("_", "-")
+        if kernel and name not in session and flag not in session:
+            problems.append(
+                f"{rel}: kernel lever {name} is not exercised by "
+                f"tools/chip_session.sh (neither {name} nor its "
+                f"bench flag {flag} appears in the matrix)")
+    return problems
+
+
 def main() -> int:
     roots = [REPO / "mastic_tpu", REPO / "tests", REPO / "tools"]
     files = [REPO / "bench.py", REPO / "__graft_entry__.py"]
@@ -308,6 +353,7 @@ def main() -> int:
         problems += check_file(path)
     problems += check_annotations_resolve()
     problems += check_call_signatures(files)
+    problems += check_env_levers()
     for problem in problems:
         print(problem)
     print(f"lint: {len(files)} files, {len(problems)} problem(s)")

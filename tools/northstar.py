@@ -49,6 +49,56 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
+# -- checkpoint container ---------------------------------------------
+#
+# The run state blob (HeavyHittersRun.to_bytes) only binds the verify
+# key / ctx / thresholds and tree shape; the synthetic reports are
+# rebuilt from CLI args, so a resume with a different --seed /
+# --planted / --inst silently continues carried state over mismatched
+# reports and only surfaces as ok=false after the full remaining wall
+# time (ADVICE r5).  The checkpoint therefore stamps every parameter
+# the report rebuild depends on into its header, and --resume verifies
+# them before touching the run state.
+
+SHARD_PARAM_KEYS = ("inst", "reports", "bits", "seed", "planted",
+                    "max_weight", "tail_weight")
+
+
+def shard_params(args) -> dict:
+    """The CLI parameters the synthetic report batch is a pure
+    function of (plant_paths + weight assignment + shard RNG)."""
+    return {k: getattr(args, k) for k in SHARD_PARAM_KEYS}
+
+
+def write_checkpoint_bytes(vk: bytes, params: dict,
+                           blob: bytes) -> bytes:
+    """vk-length | vk | params-length | params-json | run blob."""
+    header = json.dumps(params, sort_keys=True).encode()
+    return (len(vk).to_bytes(2, "little") + vk
+            + len(header).to_bytes(4, "little") + header + blob)
+
+
+def read_checkpoint_bytes(raw: bytes) -> tuple:
+    """Inverse of write_checkpoint_bytes -> (vk, params, blob)."""
+    klen = int.from_bytes(raw[:2], "little")
+    vk = raw[2:2 + klen]
+    off = 2 + klen
+    plen = int.from_bytes(raw[off:off + 4], "little")
+    try:
+        params = json.loads(raw[off + 4:off + 4 + plen])
+    except ValueError:
+        raise ValueError(
+            "checkpoint has no shard-parameter header (written by an "
+            "older tools/northstar.py) — re-run without --resume")
+    return (vk, params, raw[off + 4 + plen:])
+
+
+def verify_shard_params(saved: dict, current: dict) -> list:
+    """Mismatched parameter names (resume must refuse on any)."""
+    return sorted(k for k in set(saved) | set(current)
+                  if saved.get(k) != current.get(k))
+
+
 def plant_paths(rng, planted: int, bits: int):
     """Full-width planted heavy-hitter paths, (planted, bits) bool.
 
@@ -121,6 +171,41 @@ def main() -> None:
                              "deterministically from --seed, so only "
                              "the run state needs the file)")
     args = parser.parse_args()
+
+    if args.checkpoint_every < 1:
+        # A value of 0 used to crash with ZeroDivisionError at
+        # `run.level % args.checkpoint_every` — after the first
+        # (possibly long) level completed (ADVICE r5).
+        parser.error(f"--checkpoint-every must be >= 1 "
+                     f"(got {args.checkpoint_every})")
+
+    # Read and verify the checkpoint BEFORE the jax import and the
+    # multi-minute shard phase: a mismatched resume fails in
+    # milliseconds, not after the full remaining wall time (ADVICE
+    # r5 — the run state blob binds vk/ctx/thresholds but the
+    # synthetic reports are rebuilt from these CLI args).
+    resumed_from = None
+    ckpt_blob = None
+    vk = None
+    if args.resume:
+        if not args.checkpoint:
+            parser.error("--resume needs --checkpoint PATH")
+        with open(args.checkpoint, "rb") as f:
+            raw = f.read()
+        (vk, saved_params, ckpt_blob) = read_checkpoint_bytes(raw)
+        mismatched = verify_shard_params(saved_params,
+                                         shard_params(args))
+        if mismatched:
+            detail = ", ".join(
+                f"{k}: checkpoint={saved_params.get(k)!r} "
+                f"vs run={getattr(args, k, None)!r}"
+                for k in mismatched)
+            print(f"--resume refused: the checkpoint was written for "
+                  f"different shard parameters ({detail}); the "
+                  f"rebuilt reports would not match the carried "
+                  f"state and the run would only fail at the end",
+                  file=sys.stderr)
+            sys.exit(2)
 
     if args.mesh:
         if args.chunk_size % args.mesh:
@@ -266,20 +351,12 @@ def main() -> None:
         mesh = make_mesh(args.mesh, nodes_axis=1)
         stamp(f"mesh: report axis sharded over {args.mesh} devices")
 
-    # Checkpoint file = 2-byte vk length + vk + HeavyHittersRun blob.
-    # The vk rides along because the blob's binding digest pins it
-    # (a fresh key would silently reject every carried report).
-    resumed_from = None
-    ckpt_blob = None
-    if args.resume:
-        if not args.checkpoint:
-            parser.error("--resume needs --checkpoint PATH")
-        with open(args.checkpoint, "rb") as f:
-            raw = f.read()
-        klen = int.from_bytes(raw[:2], "little")
-        vk = raw[2:2 + klen]
-        ckpt_blob = raw[2 + klen:]
-    else:
+    # Checkpoint file = vk + shard-parameter header + HeavyHittersRun
+    # blob (write_checkpoint_bytes, read + verified at parse time
+    # above).  The vk rides along because the blob's binding digest
+    # pins it (a fresh key would silently reject every carried
+    # report); the header pins the report rebuild.
+    if vk is None:
         vk = gen_rand(m.VERIFY_KEY_SIZE)
 
     thresholds = {"default": threshold}
@@ -312,8 +389,8 @@ def main() -> None:
     def save_checkpoint() -> None:
         tmp = args.checkpoint + ".tmp"
         with open(tmp, "wb") as f:
-            f.write(len(vk).to_bytes(2, "little") + vk
-                    + run.to_bytes())
+            f.write(write_checkpoint_bytes(vk, shard_params(args),
+                                           run.to_bytes()))
         os.replace(tmp, args.checkpoint)
 
     stamp(f"rounds: threshold={threshold} planted={args.planted}")
