@@ -2,22 +2,29 @@
 # /root/reference/.github/workflows/test.yml:33-43, lint at
 # lint-python.yml:24-40).
 #
-#   make ci      fast gate: lint + typecheck (if mypy installed) +
-#                fast-tier tests (scalar + kernel smokes; <5 min cold
-#                on a 1-CPU host with a warm compile cache)
+#   make ci      fast gate: lint + analyze + typecheck (if mypy
+#                installed) + fast-tier tests (scalar + kernel
+#                smokes; <5 min cold on a 1-CPU host with a warm
+#                compile cache)
+#   make analyze trace-safety / dtype / secret-flow / pallas static
+#                analyzer (tools/analysis/; rule table in USAGE.md) —
+#                exits non-zero on any unsuppressed finding
 #   make test    full suite (adds the slow differential/adversarial/
 #                driver tiers)
 #   make bench   single-chip benchmark (prints one JSON line)
 
 PY ?= python
 
-.PHONY: ci lint typecheck test-fast test test-slow test-slow-1 \
-	test-slow-2 bench
+.PHONY: ci lint analyze typecheck test-fast test test-slow \
+	test-slow-1 test-slow-2 bench
 
-ci: lint typecheck test-fast
+ci: lint analyze typecheck test-fast
 
 lint:
 	$(PY) tools/lint.py
+
+analyze:
+	$(PY) -m tools.analysis
 
 typecheck:
 	@if $(PY) -c "import mypy" 2>/dev/null; then \

@@ -63,6 +63,10 @@ def _expand_key(key: bytes) -> list[bytes]:
     for i in range(4, 44):
         temp = words[i - 1]
         if i % 4 == 0:
+            # mastic-allow: SF002 — scalar CPU reference only: the
+            # TPU path computes SubBytes as a bitsliced boolean
+            # circuit with no table lookups (ops/aes_jax.py,
+            # ops/sbox_tower.py), which is the constant-time form
             temp = bytes([SBOX[temp[1]] ^ rcon, SBOX[temp[2]],
                           SBOX[temp[3]], SBOX[temp[0]]])
             rcon = _gf_mul(rcon, 2)
@@ -92,6 +96,8 @@ class Aes128:
         state = bytes(a ^ b for (a, b) in zip(block, self.round_keys[0]))
         for round_index in range(1, 11):
             # SubBytes
+            # mastic-allow: SF002 — scalar CPU reference only; the
+            # constant-time path is the bitsliced circuit in ops/
             state = bytes(SBOX[b] for b in state)
             # ShiftRows: row r (bytes r, r+4, r+8, r+12) rotates left by r.
             state = bytes(state[(i + 4 * (i % 4)) % 16] for i in range(16))

@@ -457,6 +457,9 @@ class BatchedMastic:
     # -- host boundary ---------------------------------------------
 
     def agg_share_to_host(self, agg_share: jax.Array) -> list:
+        # mastic-allow: TS003 — host-boundary converter: runs on
+        # concrete device arrays outside any jit trace, where
+        # np.asarray is the device-to-host transfer
         arr = np.asarray(agg_share)
         return [self.m.field(self.spec.limbs_to_int(arr[i]))
                 for i in range(arr.shape[0])]

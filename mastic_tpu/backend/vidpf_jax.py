@@ -429,6 +429,9 @@ class BatchedVidpf:
         from ..ops.level_pallas import level_step_pallas
 
         (seed_cw, ctrl_cw, w_cw, proof_cw) = cw_slice
+        # mastic-allow: TS004 — deliberate trace-time constant:
+        # interpret mode is baked per backend and jax retraces per
+        # backend, so the frozen value can never go stale
         (next_seed, ct, w, ok, proof) = level_step_pallas(
             self.spec, self.convert_blocks, ext_rk, conv_rk,
             parents.seed, parents.ctrl,
@@ -523,6 +526,9 @@ class BatchedVidpf:
     def w_to_host(self, w: jax.Array) -> list:
         """(..., VALUE_LEN, n) plain limbs -> nested lists of scalar
         field elements."""
+        # mastic-allow: TS003 — host-boundary converter: runs on
+        # concrete device arrays outside any jit trace, where
+        # np.asarray is the device-to-host transfer
         arr = np.asarray(w)
         if arr.ndim == 2:
             return [self.field(self.spec.limbs_to_int(arr[j]))

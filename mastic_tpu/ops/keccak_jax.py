@@ -118,6 +118,9 @@ def keccak_p1600(lo: jax.Array, hi: jax.Array, num_rounds: int = 12):
     if USE_PALLAS:
         from .keccak_pallas import keccak_p1600_pallas
 
+        # mastic-allow: TS004 — deliberate trace-time constant:
+        # interpret mode must be baked per backend, and jax retraces
+        # per backend, so the frozen value can never go stale
         return keccak_p1600_pallas(
             lo, hi, num_rounds,
             interpret=jax.default_backend() == "cpu")

@@ -142,15 +142,26 @@ class Vidpf(Generic[F]):
             # Seed/ctrl corrections: arranged so that after correction,
             # on-path children differ (ctrl shares of 1) while off-path
             # children collide (ctrl shares of 0).
+            #
+            # Timing note on the suppressions below: gen() is client
+            # code running over the client's OWN (alpha, beta) — no
+            # other party observes its timing — and the deployed
+            # batched twin replaces every secret-dependent choice with
+            # a lane select (backend/vidpf_jax.py gen).
+            # mastic-allow: SF002 — client-side keygen, see note above
             seed_cw = xor(s0[lose], s1[lose])
             ctrl_cw = [
                 t0[0] ^ t1[0] ^ (not bit),
                 t0[1] ^ t1[1] ^ bit,
             ]
 
+            # mastic-allow: SF001, SF002 — client-side keygen (above)
             s0k = xor(s0[keep], seed_cw) if ctrl[0] else s0[keep]
+            # mastic-allow: SF002 — client-side keygen (above)
             t0k = t0[keep] ^ (ctrl[0] and ctrl_cw[keep])
+            # mastic-allow: SF001, SF002 — client-side keygen (above)
             s1k = xor(s1[keep], seed_cw) if ctrl[1] else s1[keep]
+            # mastic-allow: SF002 — client-side keygen (above)
             t1k = t1[keep] ^ (ctrl[1] and ctrl_cw[keep])
 
             # Convert the kept child seeds into payloads + next seeds.
@@ -162,6 +173,7 @@ class Vidpf(Generic[F]):
             # Payload correction: make the on-path payload shares sum
             # to beta.
             w_cw = vec_add(vec_sub(beta, w0), w1)
+            # mastic-allow: SF001 — client-side keygen (above)
             if ctrl[1]:
                 w_cw = vec_neg(w_cw)
 
@@ -268,16 +280,20 @@ class Vidpf(Generic[F]):
         keep = int(path[-1])
 
         (s, t) = self.extend(node.seed, ctx, nonce)
+        # mastic-allow: SF001 — scalar differential oracle; the
+        # deployed path is the backend's lane select (docstring note)
         if node.ctrl:
             s[keep] = xor(s[keep], seed_cw)
             t[keep] ^= ctrl_cw[keep]
 
         (next_seed, w) = self.convert(s[keep], ctx, nonce)
         next_ctrl = t[keep]
+        # mastic-allow: SF001 — scalar oracle, see docstring note
         if next_ctrl:
             w = vec_add(w, w_cw)
 
         proof = self.node_proof(next_seed, ctx, path)
+        # mastic-allow: SF001 — scalar oracle, see docstring note
         if next_ctrl:
             proof = xor(proof, proof_cw)
 

@@ -35,6 +35,11 @@ class Xof:
         vec: list[F] = []
         while len(vec) < length:
             val = from_le_bytes(self.next(field.ENCODED_SIZE))
+            # mastic-allow: SF001 — rejection sampling: the branch
+            # leaks only the rejection count, which is independent of
+            # the accepted outputs (standard VDAF XOF behavior; the
+            # batched twin returns the in-range mask instead,
+            # backend/xof_jax.sample_vec)
             if val < field.MODULUS:
                 vec.append(field(val))
         return vec

@@ -1,0 +1,8 @@
+"""Known-bad: suppression without a written justification (AL001)."""
+
+
+def leaky(seed: bytes) -> bytes:
+    # mastic-allow: SF001
+    if seed[0] & 1:
+        return seed[1:]
+    return seed

@@ -1,0 +1,6 @@
+"""Known-bad: stale suppression that silences nothing (AL002)."""
+
+
+def quiet(count: int) -> int:
+    # mastic-allow: SF001 — there is no secret branch left here
+    return count + 1

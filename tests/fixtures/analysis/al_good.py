@@ -1,0 +1,8 @@
+"""Known-good: a justified suppression covering a real finding."""
+
+
+def documented(seed: bytes) -> bytes:
+    # mastic-allow: SF001 — fixture: deliberate branch, documented
+    if seed[0] & 1:
+        return seed[1:]
+    return seed

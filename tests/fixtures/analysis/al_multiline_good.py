@@ -1,0 +1,11 @@
+"""Known-good: one allow above a multi-line statement covers it."""
+
+TABLE = tuple(range(256))
+
+
+def paired(key: bytes) -> int:
+    # mastic-allow: SF002 — fixture: the allow above this two-line
+    # statement must cover the finding on its continuation line too
+    total = (TABLE[key[0]]
+             + TABLE[key[1]])
+    return total

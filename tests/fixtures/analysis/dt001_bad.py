@@ -1,0 +1,9 @@
+"""Known-bad: u8/u32 mixed in one op without astype (DT001)."""
+
+import jax.numpy as jnp
+
+
+def mix():
+    bytes_ = jnp.zeros((4,), jnp.uint8)
+    words = jnp.zeros((4,), jnp.uint32)
+    return bytes_ + words

@@ -1,0 +1,9 @@
+"""Known-good: widths unified explicitly before the op (DT001)."""
+
+import jax.numpy as jnp
+
+
+def mix():
+    bytes_ = jnp.zeros((4,), jnp.uint8)
+    words = jnp.zeros((4,), jnp.uint32)
+    return bytes_.astype(jnp.uint32) + words

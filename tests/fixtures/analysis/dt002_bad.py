@@ -1,0 +1,7 @@
+"""Known-bad: narrowing astype over an unmasked shift (DT002)."""
+
+import jax.numpy as jnp
+
+
+def truncating(v):
+    return (v << 4).astype(jnp.uint8)

@@ -1,0 +1,7 @@
+"""Known-good: the widening op is masked before narrowing (DT002)."""
+
+import jax.numpy as jnp
+
+
+def masked(v):
+    return ((v << 4) & 0xFF).astype(jnp.uint8)

@@ -1,0 +1,13 @@
+"""Known-bad: literal/shift out of range for the dtype (DT003)."""
+
+import jax.numpy as jnp
+
+
+def oversized_mask():
+    x = jnp.zeros((4,), jnp.uint8)
+    return x & 0x1FF
+
+
+def oversized_shift():
+    x = jnp.zeros((4,), jnp.uint32)
+    return x >> 32

@@ -1,0 +1,9 @@
+"""Known-good: literals and shifts fit the dtype (DT003)."""
+
+import jax.numpy as jnp
+
+
+def in_range():
+    x = jnp.zeros((4,), jnp.uint8)
+    y = jnp.zeros((4,), jnp.uint32)
+    return (x & 0xFE, y >> 16)

@@ -1,0 +1,7 @@
+"""Known-bad: BlockSpec rank mismatch (PL001)."""
+
+from jax.experimental import pallas as pl
+
+
+def spec():
+    return pl.BlockSpec((8, 128), lambda i: (0, 0, i))

@@ -1,0 +1,7 @@
+"""Known-good: index_map coordinates match the block rank (PL001)."""
+
+from jax.experimental import pallas as pl
+
+
+def spec():
+    return pl.BlockSpec((8, 128), lambda i: (0, i))

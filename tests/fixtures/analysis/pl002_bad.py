@@ -1,0 +1,15 @@
+"""Known-bad: index_map arity != grid rank (PL002)."""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def call(kernel):
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((16, 256), jnp.uint32),
+        grid=(2, 2),
+        in_specs=[pl.BlockSpec((8, 128), lambda i: (0, i))],
+        out_specs=pl.BlockSpec((8, 128), lambda i, j: (i, j)),
+    )

@@ -1,0 +1,17 @@
+"""Known-good: one out spec per out shape (PL003)."""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def call(kernel):
+    return pl.pallas_call(
+        kernel,
+        out_shape=(jax.ShapeDtypeStruct((8, 128), jnp.uint32),
+                   jax.ShapeDtypeStruct((8, 128), jnp.uint32)),
+        grid=(2,),
+        in_specs=[pl.BlockSpec((8, 128), lambda i: (0, i))],
+        out_specs=(pl.BlockSpec((8, 128), lambda i: (0, i)),
+                   pl.BlockSpec((8, 128), lambda i: (0, i))),
+    )

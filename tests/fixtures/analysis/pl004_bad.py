@@ -1,0 +1,9 @@
+"""Known-bad: sublane block dim neither 1 nor 8-aligned (PL004)."""
+
+from jax.experimental import pallas as pl
+
+_ROWS = 12
+
+
+def spec():
+    return pl.BlockSpec((_ROWS, 128), lambda i: (0, i))

@@ -1,0 +1,10 @@
+"""Known-good: sublane block dims 8-aligned or 1 (PL004)."""
+
+from jax.experimental import pallas as pl
+
+_ROWS = 16
+
+
+def specs():
+    return (pl.BlockSpec((_ROWS, 128), lambda i: (0, i)),
+            pl.BlockSpec((1, 128), lambda i: (0, i)))

@@ -1,0 +1,7 @@
+"""Known-bad: branch on secret-derived data (SF001)."""
+
+
+def leaky(seed: bytes) -> bytes:
+    if seed[0] & 1:
+        return seed[1:]
+    return seed

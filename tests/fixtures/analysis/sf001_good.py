@@ -1,0 +1,9 @@
+"""Known-good: branches only on public lengths/presence (SF001)."""
+
+
+def hygienic(seed: bytes, extra=None) -> bytes:
+    if len(seed) != 16:
+        raise ValueError("incorrect seed size")
+    if extra is None:
+        return seed
+    return seed + extra

@@ -1,0 +1,7 @@
+"""Known-bad: secret-dependent table index (SF002)."""
+
+TABLE = tuple(range(256))
+
+
+def lookup(key: bytes) -> int:
+    return TABLE[key[0]]

@@ -1,0 +1,7 @@
+"""Known-good: table indexed by public loop position (SF002)."""
+
+TABLE = tuple(range(256))
+
+
+def lookup(position: int) -> int:
+    return TABLE[position % 256]

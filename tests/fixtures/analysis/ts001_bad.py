@@ -1,0 +1,17 @@
+"""Known-bad: Python branch on a traced value (TS001)."""
+
+import jax
+import jax.numpy as jnp
+
+
+def relu_sum(x: jax.Array) -> jax.Array:
+    total = jnp.sum(x)
+    if total > 0:
+        return total
+    return -total
+
+
+def drain(x: jax.Array) -> jax.Array:
+    while jnp.any(x > 0):
+        x = x - 1
+    return x

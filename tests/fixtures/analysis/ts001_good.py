@@ -1,0 +1,16 @@
+"""Known-good: branches on static shape data only (TS001)."""
+
+import jax
+import jax.numpy as jnp
+
+
+def relu_sum(x: jax.Array) -> jax.Array:
+    if x.shape[0] > 1:
+        return jnp.sum(jnp.maximum(x, 0))
+    return jnp.maximum(x, 0)
+
+
+def maybe(x: jax.Array, y=None) -> jax.Array:
+    if y is None:
+        return x
+    return jnp.where(x > 0, x, y)

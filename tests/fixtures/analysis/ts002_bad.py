@@ -1,0 +1,12 @@
+"""Known-bad: host cast of a traced value (TS002)."""
+
+import jax
+import jax.numpy as jnp
+
+
+def to_int(x: jax.Array) -> int:
+    return int(jnp.sum(x))
+
+
+def to_scalar(x: jax.Array) -> float:
+    return jnp.max(x).item()

@@ -1,0 +1,7 @@
+"""Known-good: host casts only touch static shape data (TS002)."""
+
+import jax
+
+
+def leading(x: jax.Array) -> int:
+    return int(x.shape[0])

@@ -1,0 +1,10 @@
+"""Known-bad: numpy applied to a traced value (TS003)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def mixed(x: jax.Array):
+    y = jnp.abs(x)
+    return np.sum(y)

@@ -1,0 +1,13 @@
+"""Known-bad: environment probe inside a function body (TS004)."""
+
+import os
+
+import jax
+
+
+def pick_mode() -> bool:
+    return jax.default_backend() == "cpu"
+
+
+def lever() -> bool:
+    return os.environ.get("MASTIC_FIXTURE_LEVER", "0") == "1"

@@ -1,0 +1,9 @@
+"""Known-good: environment read once at import time (TS004)."""
+
+import os
+
+LEVER = os.environ.get("MASTIC_FIXTURE_LEVER", "0") == "1"
+
+
+def lever() -> bool:
+    return LEVER

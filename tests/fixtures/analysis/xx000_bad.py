@@ -1,0 +1,5 @@
+"""Known-bad: does not parse (XX000)."""
+
+
+def broken(:
+    return 0

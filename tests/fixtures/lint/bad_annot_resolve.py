@@ -1,0 +1,5 @@
+"""Known-bad: annotation naming an undefined type (lint check 5)."""
+
+
+def exposed(value: "NoSuchType") -> int:
+    return 0

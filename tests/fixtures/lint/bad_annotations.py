@@ -1,0 +1,5 @@
+"""Known-bad: public function missing annotations (lint check 3)."""
+
+
+def exposed(value, other):
+    return value + other

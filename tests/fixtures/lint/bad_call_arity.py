@@ -1,0 +1,9 @@
+"""Known-bad: call not matching the callee signature (lint check 6)."""
+
+
+def callee(a, b):
+    return a + b
+
+
+def caller():
+    return callee(1, 2, 3)

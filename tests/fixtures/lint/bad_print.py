@@ -1,0 +1,5 @@
+"""Known-bad: print() to stdout in library code (lint check 4)."""
+
+
+def chatty() -> None:
+    print("stdout pollution")

@@ -1,0 +1,5 @@
+"""Known-bad: does not parse (lint check 1)."""
+
+
+def broken(:
+    return 0

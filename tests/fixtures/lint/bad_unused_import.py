@@ -1,0 +1,8 @@
+"""Known-bad: unused import (lint check 2)."""
+
+import os
+import sys
+
+
+def argv_len() -> int:
+    return len(sys.argv)

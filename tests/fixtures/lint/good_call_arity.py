@@ -1,0 +1,9 @@
+"""Known-good twin of bad_call_arity (lint check 6)."""
+
+
+def callee(a, b):
+    return a + b
+
+
+def caller():
+    return callee(1, b=2)

@@ -1,0 +1,7 @@
+"""Known-good lint fixture: parses, no unused imports, no prints."""
+
+import os
+
+
+def path_exists(path: str) -> bool:
+    return os.path.exists(path)

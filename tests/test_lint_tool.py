@@ -1,0 +1,131 @@
+"""Fixture tests for the 8 tools/lint.py checks (fast tier).
+
+Checks 1-4 and 6 run against known-good / known-bad snippets under
+tests/fixtures/lint/; the repo-global checks (5, 7, 8) are asserted
+clean on the shipped tree and exercised known-bad by pointing the
+module lists at fixtures.
+"""
+
+import pathlib
+
+from tools import lint
+
+FIXTURES = pathlib.Path(__file__).parent / "fixtures" / "lint"
+
+
+def rel(name: str) -> str:
+    return str((FIXTURES / name).relative_to(lint.REPO))
+
+
+# -- check 1: syntax --------------------------------------------------
+
+def test_syntax_error_flagged():
+    problems = lint.check_file(FIXTURES / "bad_syntax.py")
+    assert len(problems) == 1 and "syntax error" in problems[0]
+
+
+def test_clean_fixture_passes():
+    assert lint.check_file(FIXTURES / "good_clean.py") == []
+
+
+# -- check 2: unused imports -----------------------------------------
+
+def test_unused_import_flagged():
+    problems = lint.check_file(FIXTURES / "bad_unused_import.py")
+    assert any("unused import 'os'" in p for p in problems)
+    assert not any("'sys'" in p for p in problems)
+
+
+# -- check 3: annotations in the ANNOTATED layer ----------------------
+
+def test_missing_annotations_flagged(monkeypatch):
+    monkeypatch.setattr(lint, "ANNOTATED", [rel("bad_annotations.py")])
+    problems = lint.check_file(FIXTURES / "bad_annotations.py")
+    assert any("missing annotations: ['value', 'other']" in p
+               for p in problems)
+    assert any("missing return annotation" in p for p in problems)
+
+
+def test_annotations_not_required_outside_layer():
+    # Same file, not in ANNOTATED: the annotation standard is scoped.
+    assert lint.check_file(FIXTURES / "bad_annotations.py") == []
+
+
+# -- check 4: no print() in library code ------------------------------
+
+def test_print_flagged(monkeypatch):
+    # Fixtures live under tests/ (a PRINT_OK prefix), so narrow the
+    # allowlist to exercise the check itself.
+    monkeypatch.setattr(lint, "PRINT_OK", ())
+    problems = lint.check_file(FIXTURES / "bad_print.py")
+    assert any("print() to stdout" in p for p in problems)
+
+
+def test_print_allowed_in_tools(monkeypatch):
+    monkeypatch.setattr(lint, "PRINT_OK", ("tests/",))
+    assert lint.check_file(FIXTURES / "bad_print.py") == []
+
+
+# -- check 5: annotations resolve at runtime --------------------------
+
+def test_annotation_resolution_clean_on_repo():
+    assert lint.check_annotations_resolve() == []
+
+
+def test_unresolvable_annotation_flagged(monkeypatch):
+    monkeypatch.setattr(lint, "ANNOTATED",
+                        [rel("bad_annot_resolve.py")])
+    problems = lint.check_annotations_resolve()
+    assert any("does not resolve" in p for p in problems)
+
+
+# -- check 6: call signatures -----------------------------------------
+
+def test_call_arity_mismatch_flagged():
+    problems = lint.check_call_signatures(
+        [FIXTURES / "bad_call_arity.py"])
+    assert any("takes 2 positional arg(s), call passes 3" in p
+               for p in problems)
+
+
+def test_call_arity_good_twin_passes():
+    assert lint.check_call_signatures(
+        [FIXTURES / "good_call_arity.py"]) == []
+
+
+# -- check 7: env lever coverage --------------------------------------
+
+def test_env_levers_clean_on_repo():
+    assert lint.check_env_levers() == []
+
+
+# -- check 8: ANNOTATED <-> mypy.ini strict sync ----------------------
+
+def test_mypy_sync_clean_on_repo():
+    assert lint.check_mypy_sync() == []
+
+
+def test_mypy_sync_flags_missing_annotated(monkeypatch):
+    trimmed = [p for p in lint.ANNOTATED
+               if p != "mastic_tpu/wire.py"]
+    monkeypatch.setattr(lint, "ANNOTATED", trimmed)
+    problems = lint.check_mypy_sync()
+    assert any("mastic_tpu.wire" in p and "missing from" in p
+               for p in problems)
+
+
+def test_mypy_sync_flags_relaxed_annotated(monkeypatch):
+    # backend/ modules are ignore_errors in mypy.ini: listing one in
+    # ANNOTATED must be reported as the reverse drift.
+    monkeypatch.setattr(
+        lint, "ANNOTATED",
+        lint.ANNOTATED + ["mastic_tpu/backend/schedule.py"])
+    problems = lint.check_mypy_sync()
+    assert any("mastic_tpu.backend.schedule" in p
+               and "relaxed in mypy.ini" in p for p in problems)
+
+
+# -- the gate itself --------------------------------------------------
+
+def test_repo_lint_is_clean():
+    assert lint.main() == 0

@@ -1,0 +1,5 @@
+"""Repo tooling (lint gate, static analyzer, northstar driver).
+
+A package so `python -m tools.analysis` works; the scripts themselves
+stay directly runnable (`python tools/lint.py`).
+"""

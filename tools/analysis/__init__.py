@@ -1,0 +1,153 @@
+"""Static analyzer for the trace-safety / dtype / secret-flow / Pallas
+invariants that make this reproduction's bit-exact crypto survive
+jit + Pallas (run via `make analyze`; part of `make ci`).
+
+Four passes, each with stable rule IDs, each scoped to the layer whose
+contract it checks:
+
+  tracesafe   TS001-TS004   mastic_tpu/ops/, backend/, flp/flp_jax.py
+  dtypes      DT001-DT003   mastic_tpu/ops/ (field/AES/Keccak kernels)
+  secretflow  SF001-SF002   vidpf.py, mastic.py, aes.py, xof.py
+  pallasck    PL001-PL004   any file calling pallas_call
+
+plus the suppression meta-rules AL001 (mastic-allow without a written
+justification) and AL002 (mastic-allow that silences nothing), and
+XX000 (file does not parse).
+
+Findings are suppressed inline with `# mastic-allow: <ID>[, <ID>] —
+reason`, on the flagged line or as a comment line directly above the
+flagged statement.  There are no file-level exclusions: every accepted
+risk is written down where the code is.
+
+See USAGE.md ("Static analysis") for the rule table and workflow.
+"""
+
+import json
+import pathlib
+
+from . import dtypes, pallasck, secretflow, tracesafe
+from .core import REPO, Finding, load_file
+
+PASSES = (tracesafe, dtypes, secretflow, pallasck)
+
+DEFAULT_ROOTS = ("mastic_tpu", "tools", "bench.py")
+
+_RULE_TABLE = {}
+for _p in PASSES:
+    _RULE_TABLE.update(_p.RULES)
+_RULE_TABLE.update({
+    "AL001": "mastic-allow without a written justification",
+    "AL002": "mastic-allow that suppresses nothing",
+    "XX000": "file does not parse",
+})
+
+
+def default_files() -> list:
+    files = [REPO / "bench.py"]
+    for root in ("mastic_tpu", "tools"):
+        files += sorted((REPO / root).rglob("*.py"))
+    return [f for f in files if f.exists()]
+
+
+def _pass_applies(mod, rel: str, tree) -> bool:
+    if mod is pallasck:
+        return mod.in_scope(rel, tree)
+    return mod.in_scope(rel)
+
+
+def analyze_paths(paths, only_passes=None, force_scope=False):
+    """Run the passes over `paths`.
+
+    only_passes: iterable of pass names (e.g. {"tracesafe"}) to run a
+    subset; force_scope: apply the passes regardless of each pass's
+    path scope (how the fixture self-tests drive files that live under
+    tests/fixtures/).  Returns (findings, suppressed) where both are
+    lists of Finding — `findings` is what gates CI, `suppressed` is
+    what inline allows silenced.
+    """
+    selected = [p for p in PASSES
+                if only_passes is None or p.PASS_NAME in only_passes]
+    findings: list = []
+    suppressed: list = []
+    for path in paths:
+        path = pathlib.Path(path)
+        info = load_file(path)
+        if isinstance(info, Finding):
+            findings.append(info)
+            continue
+        raw: list = []
+        for mod in selected:
+            if force_scope or _pass_applies(mod, info.rel, info.tree):
+                raw += mod.check(info)
+        for f in raw:
+            sup = info.suppression_for(f)
+            if sup is None:
+                findings.append(f)
+            else:
+                sup.used = True
+                suppressed.append(f)
+        # Suppression hygiene: every allow must carry a reason and
+        # actually silence something.
+        for sup in info.suppressions:
+            if not sup.reason:
+                findings.append(Finding(
+                    "AL001", info.rel, sup.line,
+                    "mastic-allow without a written justification "
+                    "(add '— why this is fine')"))
+            elif not sup.used and (only_passes is None
+                                   or _covered(sup, selected)):
+                findings.append(Finding(
+                    "AL002", info.rel, sup.line,
+                    f"mastic-allow for {', '.join(sup.ids)} suppresses "
+                    "nothing — stale; remove it"))
+    findings.sort(key=Finding.key)
+    suppressed.sort(key=Finding.key)
+    return (findings, suppressed)
+
+
+def _covered(sup, selected) -> bool:
+    """Only report a stale allow when the selected passes could have
+    produced its rules (partial runs must not flag other passes')."""
+    owned = set()
+    for mod in selected:
+        owned |= set(mod.RULES)
+    return any(rid in owned for rid in sup.ids)
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="tools.analysis",
+        description="trace-safety / dtype / secret-flow / pallas "
+                    "static analyzer (rules in USAGE.md)")
+    parser.add_argument("paths", nargs="*",
+                        help="files to analyze (default: mastic_tpu/, "
+                             "tools/, bench.py)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit findings as one JSON object")
+    parser.add_argument("--pass", dest="only", action="append",
+                        choices=[p.PASS_NAME for p in PASSES],
+                        help="run only this pass (repeatable)")
+    parser.add_argument("--force-scope", action="store_true",
+                        help="apply passes regardless of path scope "
+                             "(fixture testing)")
+    args = parser.parse_args(argv)
+
+    files = ([pathlib.Path(p).resolve() for p in args.paths]
+             if args.paths else default_files())
+    (findings, suppressed_list) = analyze_paths(
+        files, only_passes=set(args.only) if args.only else None,
+        force_scope=args.force_scope)
+    if args.json:
+        print(json.dumps({
+            "findings": [f.as_json() for f in findings],
+            "suppressed": [f.as_json() for f in suppressed_list],
+            "files": len(files),
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f.text())
+        print(f"analyze: {len(files)} files, {len(findings)} "
+              f"finding(s), {len(suppressed_list)} suppressed")
+    return 1 if findings else 0

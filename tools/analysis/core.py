@@ -1,0 +1,258 @@
+"""Shared machinery for the static analyzer passes.
+
+One FileInfo per source file (AST + module-constant environment +
+suppression table), a Finding record, and the suppression semantics:
+
+    x = risky_thing()  ``mastic-allow: <RULE-ID> — why this is fine``
+
+as a trailing comment, or — for long / multi-line statements — as a
+comment-only line directly above the statement (IDs may be a comma
+list).  The examples here spell the marker without a real rule ID so
+this docstring is not itself parsed as a suppression.
+
+A suppression must name the rule ID(s) it silences and carry a written
+justification after the IDs (AL001 flags bare ones); a suppression
+that silences nothing is itself a finding (AL002), so stale allows
+cannot accumulate.
+"""
+
+import ast
+import pathlib
+import re
+
+REPO = pathlib.Path(__file__).resolve().parent.parent.parent
+
+_ALLOW_RE = re.compile(
+    r"#\s*mastic-allow:\s*"
+    r"(?P<ids>[A-Z]{2}\d{3}(?:\s*,\s*[A-Z]{2}\d{3})*)"
+    r"(?P<rest>.*)$")
+
+
+class Finding:
+    """One analyzer finding; sorts by location."""
+
+    __slots__ = ("rule", "rel", "line", "msg")
+
+    def __init__(self, rule: str, rel: str, line: int, msg: str):
+        self.rule = rule
+        self.rel = rel
+        self.line = line
+        self.msg = msg
+
+    def key(self):
+        return (self.rel, self.line, self.rule)
+
+    def text(self) -> str:
+        return f"{self.rel}:{self.line}: {self.rule}: {self.msg}"
+
+    def as_json(self) -> dict:
+        return {"rule": self.rule, "file": self.rel, "line": self.line,
+                "message": self.msg}
+
+
+class Suppression:
+    __slots__ = ("line", "ids", "reason", "comment_only", "used")
+
+    def __init__(self, line: int, ids: tuple, reason: str,
+                 comment_only: bool):
+        self.line = line
+        self.ids = ids
+        self.reason = reason
+        self.comment_only = comment_only
+        self.used = False
+
+
+def _fold(node: ast.AST, env: dict):
+    """Best-effort constant folding of int expressions: literals,
+    names bound (once) to folded ints, and +,-,*,// of those."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    if isinstance(node, ast.Name):
+        return env.get(node.id)
+    if isinstance(node, ast.BinOp):
+        left = _fold(node.left, env)
+        right = _fold(node.right, env)
+        if left is None or right is None:
+            return None
+        try:
+            if isinstance(node.op, ast.Add):
+                return left + right
+            if isinstance(node.op, ast.Sub):
+                return left - right
+            if isinstance(node.op, ast.Mult):
+                return left * right
+            if isinstance(node.op, ast.FloorDiv) and right != 0:
+                return left // right
+            if isinstance(node.op, ast.LShift):
+                return left << right
+        except (ValueError, OverflowError):
+            return None
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        val = _fold(node.operand, env)
+        return None if val is None else -val
+    return None
+
+
+class FileInfo:
+    """Parsed source + the per-file tables every pass shares."""
+
+    def __init__(self, path: pathlib.Path, rel: str, src: str,
+                 tree: ast.Module):
+        self.path = path
+        self.rel = rel
+        self.src = src
+        self.tree = tree
+        self.lines = src.splitlines()
+        self.consts = self._module_consts()
+        self.suppressions = self._parse_suppressions()
+        self.stmt_start = self._statement_starts()
+
+    def _module_consts(self) -> dict:
+        """Module-level `NAME = <int expr>` bindings, skipping names
+        assigned more than once (they are not constants)."""
+        counts: dict = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            counts[n.id] = counts.get(n.id, 0) + 1
+        env: dict = {}
+        for node in self.tree.body:
+            if isinstance(node, ast.Assign) \
+                    and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and counts.get(node.targets[0].id) == 1:
+                val = _fold(node.value, env)
+                if val is not None:
+                    env[node.targets[0].id] = val
+        return env
+
+    def fold(self, node: ast.AST, local_env: dict = None):
+        env = self.consts
+        if local_env:
+            env = dict(env)
+            env.update(local_env)
+        return _fold(node, env)
+
+    def _parse_suppressions(self) -> list:
+        out = []
+        for (i, line) in enumerate(self.lines, start=1):
+            m = _ALLOW_RE.search(line)
+            if m is None:
+                continue
+            ids = tuple(s.strip() for s in m.group("ids").split(","))
+            reason = m.group("rest").lstrip(" -–—:·")
+            comment_only = line.lstrip().startswith("#")
+            out.append(Suppression(i, ids, reason.strip(), comment_only))
+        return out
+
+    def _statement_starts(self) -> dict:
+        """Line -> start line of the smallest statement covering it
+        (continuation lines of a multi-line statement map to its first
+        line), so a comment-only allow above a statement covers every
+        finding inside it."""
+        start: dict = {}
+
+        def visit(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.stmt) and hasattr(child, "lineno"):
+                    end = getattr(child, "end_lineno", child.lineno)
+                    for ln in range(child.lineno, end + 1):
+                        start[ln] = child.lineno
+                visit(child)   # nested stmts overwrite with tighter spans
+
+        visit(self.tree)
+        return start
+
+    def suppression_for(self, finding: Finding):
+        """The suppression covering `finding`, or None: same line, or a
+        comment-only allow on the line above the enclosing statement."""
+        stmt = self.stmt_start.get(finding.line, finding.line)
+        for sup in self.suppressions:
+            if finding.rule not in sup.ids:
+                continue
+            if sup.line == finding.line:
+                return sup
+            if sup.comment_only and sup.line == stmt - 1:
+                return sup
+            # A block of consecutive comment-only allow lines above the
+            # statement (continuation comments in between are fine).
+            if sup.comment_only and sup.line < stmt:
+                gap = self.lines[sup.line:stmt - 1]
+                if all(ln.lstrip().startswith("#") for ln in gap):
+                    return sup
+        return None
+
+
+def load_file(path: pathlib.Path):
+    """FileInfo for `path`, or a Finding for unparsable source."""
+    rel = str(path.relative_to(REPO))
+    try:
+        src = path.read_text()
+        tree = ast.parse(src, filename=rel)
+    except SyntaxError as err:
+        return Finding("XX000", rel, err.lineno or 0,
+                       f"syntax error: {err.msg}")
+    return FileInfo(path, rel, src, tree)
+
+
+def call_name(node: ast.Call) -> str:
+    """Dotted name of a call target, best effort ('' if dynamic)."""
+    return dotted(node.func)
+
+
+def dotted(node: ast.AST) -> str:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted(node.value)
+        return f"{base}.{node.attr}" if base else node.attr
+    return ""
+
+
+def root_name(node: ast.AST) -> str:
+    """Leftmost name of an attribute/subscript/call chain."""
+    while isinstance(node, (ast.Attribute, ast.Subscript, ast.Call)):
+        node = node.value if not isinstance(node, ast.Call) else node.func
+    return node.id if isinstance(node, ast.Name) else ""
+
+
+def target_names(target: ast.AST) -> list:
+    """Plain names bound by an assignment target.  Attribute/Subscript
+    stores (obj.x = v, obj[i] = v) bind no *name* — tainting their
+    base object would e.g. mark `self` secret because one field is."""
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, ast.Starred):
+        return target_names(target.value)
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out = []
+        for e in target.elts:
+            out += target_names(e)
+        return out
+    return []
+
+
+def for_target_taints(target, iter_node, is_tainted) -> list:
+    """Names a `for target in iter:` loop taints, given a predicate
+    over expressions.  A literal sequence of same-length literal
+    tuples is unpacked positionally, so `for (i, x) in ((0, a), ...)`
+    taints only the positions whose values are tainted."""
+    if isinstance(target, (ast.Tuple, ast.List)) \
+            and isinstance(iter_node, (ast.Tuple, ast.List)) \
+            and iter_node.elts \
+            and all(isinstance(e, (ast.Tuple, ast.List))
+                    and len(e.elts) == len(target.elts)
+                    for e in iter_node.elts):
+        out = []
+        for (pos, sub) in enumerate(target.elts):
+            if any(is_tainted(e.elts[pos]) for e in iter_node.elts):
+                out += target_names(sub)
+        return out
+    if is_tainted(iter_node):
+        return target_names(target)
+    return []

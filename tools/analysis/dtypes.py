@@ -1,0 +1,303 @@
+"""Pass 2 — dtype discipline in the field/AES/Keccak kernels.
+
+Scope: mastic_tpu/ops/ — the modules where bit-exactness is the
+contract and every limb is a uint8/uint32 whose width is part of the
+math.  A small dtype lattice ({uint8, uint16, uint32, bool, unknown})
+is walked over each function body: dtypes enter through explicit
+constructors (`jnp.uint32(x)`, `_U8(x)` aliases, `astype`, the dtype
+arguments of jnp.zeros/full/arange/asarray/sum) and propagate through
+assignments, slicing, `.at[...].set`, and the shape-preserving jnp
+ops.  The walker is conservative: unknown never flags.
+
+Rules:
+  DT001  binary op mixing two *known, different* unsigned widths
+         (uint8 with uint32) without an explicit astype — jnp promotes
+         silently and the narrow side's overflow semantics are lost.
+  DT002  `.astype(uint8)` over an expression containing a widening op
+         (`<<` or `*`) that is not already masked down to the target
+         range (`& 0xFF`-style): the astype silently truncates bits
+         the widening op produced.  Where the truncation IS the math
+         (AES xtime), suppress with the justification.
+  DT003  bare int literal mixed with a known-dtype array when the
+         literal does not fit the dtype (e.g. `u8 & 0x1FF`), or a
+         shift count >= the dtype's bit width — both are silent
+         all-zeros/garbage on device.
+"""
+
+import ast
+
+from .core import Finding, call_name, root_name
+
+PASS_NAME = "dtypes"
+
+RULES = {
+    "DT001": "implicit promotion between different unsigned widths",
+    "DT002": "narrowing astype over an unmasked widening op",
+    "DT003": "int literal / shift count out of range for the dtype",
+}
+
+SCOPE_PREFIXES = ("mastic_tpu/ops/",)
+
+_DTYPE_ATTRS = {"uint8": "u8", "uint16": "u16", "uint32": "u32",
+                "int32": "i32", "int64": "i64", "bool_": "bool"}
+_MAX = {"u8": 0xFF, "u16": 0xFFFF, "u32": 0xFFFFFFFF}
+_BITS = {"u8": 8, "u16": 16, "u32": 32}
+_UNSIGNED = {"u8", "u16", "u32"}
+# jnp calls that preserve the dtype of their first array argument.
+_PRESERVE = {"reshape", "concatenate", "stack", "moveaxis", "roll",
+             "broadcast_to", "pad", "where", "transpose", "squeeze",
+             "expand_dims", "flip", "swapaxes", "zeros_like",
+             "ones_like", "tile", "repeat"}
+# array methods that preserve the receiver's dtype.
+_PRESERVE_METHODS = {"reshape", "set", "add", "get", "min", "max",
+                     "multiply", "transpose"}
+_DTYPE_ARG_FNS = {"zeros", "ones", "full", "empty", "asarray",
+                  "arange", "array", "sum", "iota", "broadcasted_iota"}
+
+
+def in_scope(rel: str) -> bool:
+    return rel.startswith(SCOPE_PREFIXES)
+
+
+def _dtype_aliases(tree: ast.Module) -> dict:
+    """Module-level `_U32 = jnp.uint32` style aliases -> lattice tag."""
+    aliases = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Attribute) \
+                and node.value.attr in _DTYPE_ATTRS \
+                and root_name(node.value) in ("jnp", "np", "numpy"):
+            aliases[node.targets[0].id] = _DTYPE_ATTRS[node.value.attr]
+    return aliases
+
+
+class _DtypeWalker:
+    def __init__(self, fn, info, aliases, findings):
+        self.fn = fn
+        self.info = info
+        self.aliases = aliases
+        self.findings = findings
+        self.env: dict = {}
+
+    # -- dtype of an expression ------------------------------------
+
+    def dtype_ref(self, node):
+        """`node` used as a dtype *reference* (jnp.uint8, _U8, bool)."""
+        if isinstance(node, ast.Attribute) and node.attr in _DTYPE_ATTRS \
+                and root_name(node) in ("jnp", "np", "numpy"):
+            return _DTYPE_ATTRS[node.attr]
+        if isinstance(node, ast.Name):
+            if node.id in self.aliases:
+                return self.aliases[node.id]
+            if node.id == "bool":
+                return "bool"
+        return None
+
+    def dtype_of(self, node):
+        if node is None:
+            return None
+        if isinstance(node, ast.Name):
+            return self.env.get(node.id)
+        if isinstance(node, ast.Subscript):
+            return self.dtype_of(node.value)
+        if isinstance(node, ast.Attribute):
+            if node.attr in ("at", "T"):
+                return self.dtype_of(node.value)
+            return None
+        if isinstance(node, ast.Call):
+            return self._dtype_of_call(node)
+        if isinstance(node, ast.BinOp):
+            left = self.dtype_of(node.left)
+            right = self.dtype_of(node.right)
+            if isinstance(node.op, (ast.LShift, ast.RShift)):
+                return left      # shifts keep the left operand's dtype
+            if left is not None and right is None:
+                return left
+            if right is not None and left is None:
+                return right
+            if left == right:
+                return left
+            return None          # mixed: DT001's business, not ours
+        if isinstance(node, ast.UnaryOp):
+            return self.dtype_of(node.operand)
+        if isinstance(node, ast.IfExp):
+            return self.dtype_of(node.body) or self.dtype_of(node.orelse)
+        return None
+
+    def _dtype_of_call(self, node: ast.Call):
+        ctor = self.dtype_ref(node.func)
+        if ctor is not None:
+            return ctor          # _U32(x), jnp.uint8(x)
+        if isinstance(node.func, ast.Attribute):
+            attr = node.func.attr
+            if attr == "astype" and node.args:
+                return self.dtype_ref(node.args[0])
+            name = call_name(node)
+            root = root_name(node.func)
+            if root in ("jnp", "np", "numpy", "lax", "jax"):
+                if attr in _DTYPE_ARG_FNS:
+                    for kw in node.keywords:
+                        if kw.arg == "dtype":
+                            return self.dtype_ref(kw.value)
+                    if attr in ("zeros", "ones", "full", "empty"):
+                        if len(node.args) >= 2 + (attr == "full"):
+                            return self.dtype_ref(node.args[-1])
+                    if attr in ("asarray", "array") \
+                            and len(node.args) >= 2:
+                        return self.dtype_ref(node.args[1])
+                    if attr in ("iota", "broadcasted_iota") \
+                            and node.args:
+                        return self.dtype_ref(node.args[0])
+                    return None
+                if attr in _PRESERVE:
+                    for a in node.args:
+                        if attr == "where" and a is node.args[0]:
+                            continue   # dtype comes from the branches
+                        d = self.dtype_of(a)
+                        if d is not None:
+                            return d
+                    return None
+                if attr in ("zeros_like", "ones_like") and node.args:
+                    return self.dtype_of(node.args[0])
+            if attr in _PRESERVE_METHODS:
+                return self.dtype_of(node.func.value)
+        return None
+
+    # -- propagation + checks --------------------------------------
+
+    def run(self):
+        from .tracesafe import iter_scope
+
+        for _ in range(10):
+            before = dict(self.env)
+            for node in iter_scope(self.fn):
+                if isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    d = self.dtype_of(node.value)
+                    if d is not None:
+                        self.env[node.targets[0].id] = d
+            if self.env == before:
+                break
+        for node in iter_scope(self.fn):
+            if isinstance(node, ast.BinOp):
+                self._check_binop(node)
+            elif isinstance(node, ast.Call):
+                self._check_astype(node)
+
+    def _flag(self, rule, node, msg):
+        self.findings.append(
+            Finding(rule, self.info.rel, node.lineno, msg))
+
+    def _literal(self, node):
+        return self.info.fold(node)
+
+    def _check_binop(self, node: ast.BinOp):
+        left = self.dtype_of(node.left)
+        right = self.dtype_of(node.right)
+        # DT003: literal operand out of range for the known side.
+        for (known, other) in ((left, node.right), (right, node.left)):
+            if known not in _UNSIGNED:
+                continue
+            lit = self._literal(other)
+            if lit is None:
+                continue
+            if isinstance(node.op, (ast.LShift, ast.RShift)) \
+                    and other is node.right:
+                if lit >= _BITS[known]:
+                    self._flag("DT003", node,
+                               f"shift by {lit} on a {known} value "
+                               f"(width {_BITS[known]}) is all-zeros")
+            elif lit > _MAX[known] or lit < 0:
+                self._flag("DT003", node,
+                           f"literal {hex(lit) if lit >= 0 else lit} "
+                           f"does not fit {known} "
+                           f"(max {hex(_MAX[known])})")
+            return
+        # DT001: two known, different unsigned widths.
+        if left in _UNSIGNED and right in _UNSIGNED and left != right \
+                and not isinstance(node.op, (ast.LShift, ast.RShift)):
+            self._flag("DT001", node,
+                       f"binary op mixes {left} and {right} — promote "
+                       "explicitly with astype")
+
+    def _check_astype(self, node: ast.Call):
+        if not (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "astype" and node.args):
+            return
+        target = self.dtype_ref(node.args[0])
+        if target != "u8":
+            return
+        hit = _find_unmasked_widening(node.func.value, _MAX[target])
+        if hit is not None:
+            self._flag("DT002", node,
+                       "astype(uint8) truncates an expression with an "
+                       f"unmasked widening op ('{ast.unparse(hit)[:48]}'"
+                       ") — mask with & 0xFF first or suppress with "
+                       "the justification")
+
+
+def _find_unmasked_widening(node, target_max):
+    """First `<<` or `*` BinOp inside `node` not already below a
+    masking `& <literal <= target_max>` or an inner astype."""
+    if isinstance(node, ast.BinOp):
+        if isinstance(node.op, ast.BitAnd):
+            for side in (node.left, node.right):
+                lit = _mask_literal(side)
+                if lit is not None and 0 <= lit <= target_max:
+                    return None   # the mask bounds the whole subtree
+        if isinstance(node.op, (ast.LShift, ast.Mult)):
+            return node
+        return (_find_unmasked_widening(node.left, target_max)
+                or _find_unmasked_widening(node.right, target_max))
+    if isinstance(node, ast.Call):
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "astype":
+            return None          # inner conversion resets the range
+        hits = [_find_unmasked_widening(a, target_max)
+                for a in node.args]
+        return next((h for h in hits if h is not None), None)
+    if isinstance(node, (ast.Subscript, ast.Attribute)):
+        return None              # reads of stored values, not widening
+    if isinstance(node, ast.UnaryOp):
+        return _find_unmasked_widening(node.operand, target_max)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        hits = [_find_unmasked_widening(e, target_max)
+                for e in node.elts]
+        return next((h for h in hits if h is not None), None)
+    return None
+
+
+def _mask_literal(node):
+    """Literal int of a masking operand: 0xFF, _U8(0xFF), uint8(255)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return node.value
+    if isinstance(node, ast.Call) and len(node.args) == 1 \
+            and isinstance(node.args[0], ast.Constant) \
+            and isinstance(node.args[0].value, int):
+        return node.args[0].value
+    return None
+
+
+def check(info) -> list:
+    aliases = _dtype_aliases(info.tree)
+    findings: list = []
+
+    def visit(body):
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _DtypeWalker(node, info, aliases, findings).run()
+                visit(node.body)
+            elif isinstance(node, ast.ClassDef):
+                visit(node.body)
+
+    visit(info.tree.body)
+    seen = set()
+    out = []
+    for f in findings:
+        if f.key() in seen:
+            continue
+        seen.add(f.key())
+        out.append(f)
+    return out

@@ -1,0 +1,233 @@
+"""Pass 3 — secret-flow / constant-time taint on the scalar layer.
+
+Scope: mastic_tpu/vidpf.py, mastic_tpu/mastic.py, mastic_tpu/aes.py,
+mastic_tpu/xof.py — the scalar protocol layer, where the draft's
+timing-hygiene expectations live (the batched backend replaces every
+secret-dependent choice with a lane select by construction; the scalar
+layer is where a branch on a seed-derived bit can actually leak).
+
+Taint sources (intraprocedural, per function, to a fixpoint):
+  * parameters whose name marks secret material (seed/key/rand/alpha/
+    beta/measurement/input_share and _seed/_key/_rand suffixes);
+  * attribute reads of secret node state (.seed, .ctrl, .w,
+    .round_keys);
+  * calls that produce XOF/PRG output or key material (.next,
+    .next_vec, .derive_seed, .encrypt_block, .extend, .convert, .gen,
+    .get_beta_share);
+  * any value computed from a tainted value (calls with tainted
+    arguments taint their result — int()/bool() casts preserve
+    secrecy).
+
+`len(x)` and `x is None` escape the taint: lengths and presence are
+public protocol parameters in every construction here.
+
+Rules:
+  SF001  Python branch (`if`/`while`/ternary/`assert`) on a tainted
+         value — secret-dependent control flow.
+  SF002  subscript whose *index* is tainted — secret-dependent memory
+         access (the classic table-lookup timing channel).
+
+Known limitation (by design — the analysis is intraprocedural): taint
+does not follow values into callees, so e.g. a variable-time helper
+called *with* secret bytes is the call site's finding, not the
+helper's.  The scalar layer is the differential oracle, not the
+deployment path; real findings here are suppressed with that
+justification rather than rewritten, and the backend twins are the
+constant-time forms.
+"""
+
+import ast
+
+from .core import Finding, call_name, for_target_taints, target_names
+
+PASS_NAME = "secretflow"
+
+RULES = {
+    "SF001": "branch on secret-derived value",
+    "SF002": "secret-dependent subscript index",
+}
+
+SCOPE_FILES = ("mastic_tpu/vidpf.py", "mastic_tpu/mastic.py",
+               "mastic_tpu/aes.py", "mastic_tpu/xof.py")
+
+_SECRET_PARAMS = {"seed", "seeds", "key", "keys", "rand", "alpha",
+                  "alphas", "beta", "betas", "block", "measurement",
+                  "measurements", "input_share", "input_shares",
+                  "weight", "verify_key"}
+_SECRET_SUFFIXES = ("_seed", "_seeds", "_key", "_keys", "_rand",
+                    "_rands")
+_SECRET_ATTRS = {"seed", "ctrl", "w", "round_keys"}
+_SECRET_CALLS = {"next", "next_vec", "derive_seed", "expand_into_vec",
+                 "encrypt_block", "extend", "convert", "gen",
+                 "get_beta_share"}
+_HOST_SAFE = {"len", "isinstance", "range", "enumerate", "hasattr",
+              "type", "print", "sorted", "ValueError", "TypeError",
+              "set"}
+
+
+def in_scope(rel: str) -> bool:
+    return rel in SCOPE_FILES
+
+
+def _secret_param(name: str) -> bool:
+    return name in _SECRET_PARAMS or name.endswith(_SECRET_SUFFIXES)
+
+
+def _is_none_test(node: ast.Compare) -> bool:
+    return (len(node.ops) == 1
+            and isinstance(node.ops[0], (ast.Is, ast.IsNot)))
+
+
+class _TaintAnalysis:
+    def __init__(self, fn, info, findings, inherited=()):
+        self.fn = fn
+        self.info = info
+        self.findings = findings
+        self.tainted: set = set(inherited)
+        args = fn.args
+        for a in args.posonlyargs + args.args + args.kwonlyargs:
+            if _secret_param(a.arg):
+                self.tainted.add(a.arg)
+
+    def is_tainted(self, node) -> bool:
+        if node is None or isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Attribute):
+            if node.attr in _SECRET_ATTRS:
+                return True
+            return self.is_tainted(node.value)
+        if isinstance(node, ast.Subscript):
+            return self.is_tainted(node.value)
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if isinstance(node.func, ast.Name) and name in _HOST_SAFE:
+                return False
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _SECRET_CALLS:
+                return True
+            return (self.is_tainted(node.func)
+                    or any(self.is_tainted(a) for a in node.args)
+                    or any(self.is_tainted(k.value)
+                           for k in node.keywords))
+        if isinstance(node, ast.BinOp):
+            return (self.is_tainted(node.left)
+                    or self.is_tainted(node.right))
+        if isinstance(node, ast.UnaryOp):
+            return self.is_tainted(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self.is_tainted(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            if _is_none_test(node):
+                return False
+            return (self.is_tainted(node.left)
+                    or any(self.is_tainted(c) for c in node.comparators))
+        if isinstance(node, ast.IfExp):
+            return (self.is_tainted(node.body)
+                    or self.is_tainted(node.orelse))
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.is_tainted(e) for e in node.elts)
+        if isinstance(node, ast.Starred):
+            return self.is_tainted(node.value)
+        if isinstance(node, (ast.ListComp, ast.GeneratorExp,
+                             ast.SetComp)):
+            return (self.is_tainted(node.elt)
+                    or any(self.is_tainted(g.iter)
+                           for g in node.generators))
+        return False
+
+    def _taint_target(self, target):
+        self.tainted.update(target_names(target))
+
+    def propagate(self):
+        from .tracesafe import iter_scope
+
+        for _ in range(10):
+            before = len(self.tainted)
+            for node in iter_scope(self.fn):
+                if isinstance(node, ast.Assign):
+                    if self.is_tainted(node.value):
+                        for t in node.targets:
+                            self._taint_target(t)
+                elif isinstance(node, ast.AugAssign):
+                    if self.is_tainted(node.value) \
+                            or self.is_tainted(node.target):
+                        self._taint_target(node.target)
+                elif isinstance(node, ast.AnnAssign):
+                    if node.value is not None \
+                            and self.is_tainted(node.value):
+                        self._taint_target(node.target)
+                elif isinstance(node, ast.For):
+                    self.tainted.update(for_target_taints(
+                        node.target, node.iter, self.is_tainted))
+                elif isinstance(node, (ast.ListComp, ast.GeneratorExp,
+                                       ast.SetComp, ast.DictComp)):
+                    for g in node.generators:
+                        self.tainted.update(for_target_taints(
+                            g.target, g.iter, self.is_tainted))
+            if len(self.tainted) == before:
+                break
+
+    def report(self):
+        from .tracesafe import iter_scope
+
+        for node in iter_scope(self.fn):
+            if isinstance(node, (ast.If, ast.While)) \
+                    and self.is_tainted(node.test):
+                self._flag("SF001", node,
+                           "branch on secret-derived value "
+                           f"'{ast.unparse(node.test)[:60]}'")
+            elif isinstance(node, ast.IfExp) \
+                    and self.is_tainted(node.test):
+                self._flag("SF001", node,
+                           "ternary on secret-derived value "
+                           f"'{ast.unparse(node.test)[:60]}'")
+            elif isinstance(node, ast.Assert) \
+                    and self.is_tainted(node.test):
+                self._flag("SF001", node,
+                           "assert on secret-derived value")
+            elif isinstance(node, ast.Subscript) \
+                    and self.is_tainted(node.slice):
+                self._flag("SF002", node,
+                           "secret-dependent index "
+                           f"'{ast.unparse(node)[:60]}'")
+            # Comprehension iterating a secret container with a
+            # secret-indexed lookup inside is caught by the Subscript
+            # case (the loop target is tainted via propagate()).
+
+    def _flag(self, rule, node, msg):
+        self.findings.append(
+            Finding(rule, self.info.rel, node.lineno, msg))
+
+
+def _analyze(fn, info, findings, inherited=()):
+    from .tracesafe import iter_scope
+
+    ta = _TaintAnalysis(fn, info, findings, inherited)
+    ta.propagate()
+    ta.report()
+    for node in iter_scope(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _analyze(node, info, findings, set(ta.tainted))
+
+
+def check(info) -> list:
+    findings: list = []
+
+    def visit(body):
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _analyze(node, info, findings)
+            elif isinstance(node, ast.ClassDef):
+                visit(node.body)
+
+    visit(info.tree.body)
+    seen = set()
+    out = []
+    for f in findings:
+        if f.key() in seen:
+            continue
+        seen.add(f.key())
+        out.append(f)
+    return out

@@ -1,0 +1,299 @@
+"""Pass 1 — trace-safety over the jitted/Pallas layer.
+
+Scope: mastic_tpu/ops/, mastic_tpu/backend/, mastic_tpu/flp/flp_jax.py
+(the modules whose function bodies run under jax.jit / lax control flow
+/ pallas_call, where a Python-level branch or cast on a traced array is
+either a silent trace-time freeze or a ConcretizationTypeError on the
+first jit).
+
+Rules:
+  TS001  Python `if` / `while` / ternary / `assert` whose condition
+         involves a traced-array value (lane data must use jnp.where /
+         lax.select / lax.cond; shape/dtype predicates are static and
+         not flagged).
+  TS002  int() / bool() / float() / .item() / .tolist() applied to a
+         traced-array value (forces concretization).
+  TS003  numpy (`np.*`) called on a traced-array value (silently
+         escapes the trace; `jnp` / `lax` is required on traced data).
+  TS004  trace-time environment probe inside a function body
+         (jax.default_backend(), os.environ reads): the value freezes
+         into the compiled program at trace time, which is a staleness
+         hazard unless deliberate — suppress with the justification.
+
+Array-ness is inferred per function (to a fixpoint, so loop-carried
+values are seen): parameters annotated `jax.Array`/`jnp.ndarray`, all
+parameters of kernel/scan-style bodies (pallas `*_ref`/`refs` params;
+functions named kernel/body/step/cond), results of jnp./jax./lax.
+calls, and anything computed from those.  Nested functions inherit the
+enclosing function's traced set (closures over traced values are how
+pallas kernels and scan bodies are written here).  `.shape`/`.ndim`/
+`.dtype`/`.size` reads and `is None` tests escape the taint —
+branching on static shape data is exactly what trace-time Python is
+for.  The inference is conservative: a value is only traced if the
+analyzer can see it flow from a traced source, so host-side numpy
+precomputation never trips the rules.
+"""
+
+import ast
+
+from .core import (Finding, call_name, for_target_taints, root_name,
+                   target_names)
+
+PASS_NAME = "tracesafe"
+
+RULES = {
+    "TS001": "Python branch on a traced-array value",
+    "TS002": "host cast (int/bool/float/.item) on a traced-array value",
+    "TS003": "numpy call on a traced-array value (jnp/lax required)",
+    "TS004": "trace-time environment probe inside a function body",
+}
+
+SCOPE_PREFIXES = ("mastic_tpu/ops/", "mastic_tpu/backend/")
+SCOPE_FILES = ("mastic_tpu/flp/flp_jax.py",)
+
+# Attributes whose value is static Python data even on a tracer.
+_ESCAPE_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize",
+                 "nbytes", "weak_type", "sharding"}
+# Builtins that never return traced values.
+_HOST_SAFE = {"len", "isinstance", "hasattr", "getattr", "callable",
+              "type", "id", "repr", "str", "print", "range",
+              "enumerate", "sorted", "abs", "format", "zip"}
+# jax.* helpers that return host (non-traced) objects.
+_JAX_HOST = {"jax.ShapeDtypeStruct", "jax.default_backend",
+             "jax.devices", "jax.device_count",
+             "jax.local_device_count", "jax.make_mesh"}
+_TRACED_ROOTS = ("jnp", "lax", "pl", "pltpu")
+_KERNEL_FN_NAMES = {"kernel", "body", "step", "cond"}
+_CAST_FNS = {"int", "bool", "float", "complex"}
+_ITEM_ATTRS = {"item", "tolist"}
+_ENV_PROBES = {"jax.default_backend", "os.environ.get", "os.getenv"}
+
+
+def in_scope(rel: str) -> bool:
+    return rel.startswith(SCOPE_PREFIXES) or rel in SCOPE_FILES
+
+
+def iter_scope(fn):
+    """All nodes of `fn`'s own body, not descending into nested
+    function definitions (they are analyzed separately, with this
+    scope's traced set inherited)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _annotation_is_array(node) -> bool:
+    if node is None:
+        return False
+    text = ast.unparse(node)
+    return ("jax.Array" in text or "jnp.ndarray" in text
+            or "ArrayLike" in text)
+
+
+def _is_none_test(node: ast.Compare) -> bool:
+    return (len(node.ops) == 1
+            and isinstance(node.ops[0], (ast.Is, ast.IsNot)))
+
+
+class _FnAnalysis:
+    """Traced-value inference + sink reporting for one function."""
+
+    def __init__(self, fn, info, findings, inherited=()):
+        self.fn = fn
+        self.info = info
+        self.findings = findings
+        self.traced: set = set(inherited)
+        self._seed_params()
+
+    def _seed_params(self):
+        args = self.fn.args
+        all_args = args.posonlyargs + args.args + args.kwonlyargs
+        # In scan/while bodies every param is a traced carry/slice; in
+        # other functions only the pallas ref params are traced (a
+        # static `meta` param next to a `refs` param stays host data).
+        scan_body = self.fn.name in _KERNEL_FN_NAMES
+        for a in all_args:
+            if _annotation_is_array(a.annotation):
+                self.traced.add(a.arg)
+            elif a.arg.endswith("_ref") or a.arg == "refs":
+                self.traced.add(a.arg)
+            elif scan_body and a.arg not in ("self", "cls"):
+                self.traced.add(a.arg)
+        if args.vararg is not None and (
+                scan_body or args.vararg.arg == "refs"
+                or args.vararg.arg.endswith("_refs")):
+            self.traced.add(args.vararg.arg)
+
+    # -- expression taint ------------------------------------------
+
+    def is_traced(self, node) -> bool:
+        if node is None or isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in self.traced
+        if isinstance(node, ast.Attribute):
+            if node.attr in _ESCAPE_ATTRS:
+                return False
+            return self.is_traced(node.value)
+        if isinstance(node, ast.Subscript):
+            return self.is_traced(node.value)
+        if isinstance(node, ast.Call):
+            return self._call_traced(node)
+        if isinstance(node, ast.BinOp):
+            return self.is_traced(node.left) or self.is_traced(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.is_traced(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self.is_traced(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            if _is_none_test(node):
+                return False
+            return (self.is_traced(node.left)
+                    or any(self.is_traced(c) for c in node.comparators))
+        if isinstance(node, ast.IfExp):
+            return self.is_traced(node.body) or self.is_traced(node.orelse)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.is_traced(e) for e in node.elts)
+        if isinstance(node, ast.Starred):
+            return self.is_traced(node.value)
+        if isinstance(node, (ast.ListComp, ast.GeneratorExp, ast.SetComp)):
+            return (self.is_traced(node.elt)
+                    or any(self.is_traced(g.iter)
+                           for g in node.generators))
+        if isinstance(node, ast.DictComp):
+            return (self.is_traced(node.value)
+                    or any(self.is_traced(g.iter)
+                           for g in node.generators))
+        return False
+
+    def _call_traced(self, node: ast.Call) -> bool:
+        name = call_name(node)
+        root = root_name(node.func)
+        if isinstance(node.func, ast.Name) and name in _HOST_SAFE:
+            return False
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _ESCAPE_ATTRS | _ITEM_ATTRS:
+            return False
+        if name in _JAX_HOST:
+            return False
+        if root in ("np", "numpy"):
+            return False      # numpy results are host constants
+        if root in _TRACED_ROOTS or name.startswith("jax."):
+            return True
+        return (any(self.is_traced(a) for a in node.args)
+                or any(self.is_traced(k.value) for k in node.keywords))
+
+    # -- propagation to fixpoint -----------------------------------
+
+    def _taint_target(self, target):
+        self.traced.update(target_names(target))
+
+    def propagate(self):
+        for _ in range(10):
+            before = len(self.traced)
+            for node in iter_scope(self.fn):
+                if isinstance(node, ast.Assign):
+                    if self.is_traced(node.value):
+                        for t in node.targets:
+                            self._taint_target(t)
+                elif isinstance(node, ast.AugAssign):
+                    if self.is_traced(node.value) \
+                            or self.is_traced(node.target):
+                        self._taint_target(node.target)
+                elif isinstance(node, (ast.AnnAssign, ast.NamedExpr)):
+                    if node.value is not None \
+                            and self.is_traced(node.value):
+                        self._taint_target(node.target)
+                elif isinstance(node, ast.For):
+                    self.traced.update(for_target_taints(
+                        node.target, node.iter, self.is_traced))
+                elif isinstance(node, (ast.ListComp, ast.GeneratorExp,
+                                       ast.SetComp, ast.DictComp)):
+                    for g in node.generators:
+                        self.traced.update(for_target_taints(
+                            g.target, g.iter, self.is_traced))
+            if len(self.traced) == before:
+                break
+
+    # -- sinks ------------------------------------------------------
+
+    def _flag(self, rule, node, msg):
+        self.findings.append(
+            Finding(rule, self.info.rel, node.lineno, msg))
+
+    def report(self):
+        for node in iter_scope(self.fn):
+            if isinstance(node, (ast.If, ast.While)) \
+                    and self.is_traced(node.test):
+                self._flag("TS001", node,
+                           "Python branch on traced value "
+                           f"'{ast.unparse(node.test)[:60]}' — use "
+                           "jnp.where / lax.cond")
+            elif isinstance(node, ast.IfExp) \
+                    and self.is_traced(node.test):
+                self._flag("TS001", node,
+                           "ternary on traced value "
+                           f"'{ast.unparse(node.test)[:60]}'")
+            elif isinstance(node, ast.Assert) \
+                    and self.is_traced(node.test):
+                self._flag("TS001", node, "assert on traced value")
+            elif isinstance(node, ast.Call):
+                self._report_call(node)
+
+    def _report_call(self, node: ast.Call):
+        name = call_name(node)
+        root = root_name(node.func)
+        if isinstance(node.func, ast.Name) and name in _CAST_FNS \
+                and any(self.is_traced(a) for a in node.args):
+            self._flag("TS002", node,
+                       f"{name}() on a traced value forces "
+                       "concretization")
+        elif isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _ITEM_ATTRS \
+                and self.is_traced(node.func.value):
+            self._flag("TS002", node,
+                       f".{node.func.attr}() on a traced value")
+        elif root in ("np", "numpy") \
+                and (any(self.is_traced(a) for a in node.args)
+                     or any(self.is_traced(k.value)
+                            for k in node.keywords)):
+            self._flag("TS003", node,
+                       f"numpy call {name}() on a traced value — "
+                       "use the jnp/lax equivalent")
+        elif name in _ENV_PROBES:
+            self._flag("TS004", node,
+                       f"{name}() inside a function body is frozen "
+                       "into the trace at trace time")
+
+
+def _analyze(fn, info, findings, inherited=()):
+    fa = _FnAnalysis(fn, info, findings, inherited)
+    fa.propagate()
+    fa.report()
+    for node in iter_scope(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _analyze(node, info, findings, set(fa.traced))
+
+
+def check(info) -> list:
+    findings: list = []
+    for node in info.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _analyze(node, info, findings)
+        elif isinstance(node, ast.ClassDef):
+            for member in node.body:
+                if isinstance(member, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    _analyze(member, info, findings)
+    seen = set()
+    out = []
+    for f in findings:
+        if f.key() in seen:
+            continue
+        seen.add(f.key())
+        out.append(f)
+    return out

@@ -8,19 +8,35 @@
 #   3. Pallas fused-Keccak kernel on the headline shape (first-ever
 #      hardware execution of the 12-round form)
 # Each step has its own timeout; a hang or crash in one step must not
-# cost the rest of the window.  All JSON lines land in the log.
-set -u
+# cost the rest of the window (run() tolerates per-step failure), but
+# a scaffolding failure — bad cwd, unwritable log, broken git — must
+# abort loudly instead of producing a silent partial session log, so
+# the script runs under -euo pipefail with an exit trap that names
+# the matrix entry that was executing.
+set -euo pipefail
 cd "$(dirname "$0")/.."
 LOG="${1:-/tmp/chip_session.log}"
 exec >>"$LOG" 2>&1
+
+CURRENT="(setup)"
+on_exit() {
+    local rc=$?
+    if [ "$rc" -ne 0 ]; then
+        echo "=== chip session ABORTED (exit=$rc) at matrix entry:" \
+             "$CURRENT ==="
+    fi
+}
+trap on_exit EXIT
 
 echo "=== chip session $(date -u +%FT%TZ) rev=$(git rev-parse --short HEAD) ==="
 
 run() {
     local name="$1"; shift
+    CURRENT="$name: $*"
     echo "--- $name: $* ---"
-    timeout 2400 "$@"
-    echo "--- $name: exit=$? ---"
+    local rc=0
+    timeout 2400 "$@" || rc=$?
+    echo "--- $name: exit=$rc ---"
 }
 
 # 1. The one number the framework exists for (writes BENCH_LAST_GOOD).

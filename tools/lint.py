@@ -25,12 +25,18 @@ Checks, over mastic_tpu/, tests/, tools/ and the repo-root scripts:
    mastic_tpu/ops/ or mastic_tpu/backend/) is exercised by
    tools/chip_session.sh — either by env name or by its bench.py
    flag form (--foo-bar for MASTIC_FOO_BAR).  Prevents the r5 class
-   of "kernel exists but no session script exercises it".
+   of "kernel exists but no session script exercises it";
+8. the ANNOTATED list below stays in sync with mypy.ini's strict
+   module set (the modules under `strict = True` with no relaxing
+   override).  mypy cannot run in this image, so the two lists had
+   started to drift silently; this check makes the drift a lint
+   failure in both directions.
 
 Exit status 0 iff clean.  Run via `make lint` / `make ci`.
 """
 
 import ast
+import configparser
 import pathlib
 import re
 import sys
@@ -39,12 +45,14 @@ REPO = pathlib.Path(__file__).resolve().parent.parent
 
 # Scalar-layer modules held to the annotation standard (the batched
 # JAX layer's shapes/dtypes are documented in docstrings instead).
+# Check 8 keeps this list equal to mypy.ini's strict set.
 ANNOTATED = [
     "mastic_tpu/common.py", "mastic_tpu/dst.py", "mastic_tpu/field.py",
     "mastic_tpu/xof.py", "mastic_tpu/aes.py", "mastic_tpu/keccak.py",
     "mastic_tpu/vidpf.py", "mastic_tpu/mastic.py", "mastic_tpu/vdaf.py",
     "mastic_tpu/oracle.py", "mastic_tpu/flp/flp.py",
     "mastic_tpu/flp/circuits.py", "mastic_tpu/testvec_codec.py",
+    "mastic_tpu/wire.py",
 ]
 
 PRINT_OK = ("tools/", "bench.py", "gen_test_vec.py", "tests/",
@@ -343,17 +351,76 @@ def check_env_levers() -> list:
     return problems
 
 
+def _strict_mypy_modules(ini_path: pathlib.Path = None) -> set:
+    """Module names mypy.ini holds to the full strict standard: under
+    the global `strict = True` with no per-module override relaxing
+    them (ignore_errors or disallow_untyped_defs).  __init__ re-export
+    shims are skipped — they hold no function signatures."""
+    cfg = configparser.ConfigParser()
+    cfg.read(ini_path or REPO / "mypy.ini")
+    relaxed_patterns = []
+    for section in cfg.sections():
+        if not section.startswith("mypy-"):
+            continue
+        sub = cfg[section]
+        if sub.getboolean("ignore_errors", fallback=False) \
+                or not sub.getboolean("disallow_untyped_defs",
+                                      fallback=True):
+            relaxed_patterns.append(section[len("mypy-"):])
+
+    def relaxed(module: str) -> bool:
+        for pat in relaxed_patterns:
+            if pat.endswith(".*"):
+                if module == pat[:-2] or module.startswith(pat[:-1]):
+                    return True
+            elif module == pat:
+                return True
+        return False
+
+    strict = set()
+    for path in sorted((REPO / "mastic_tpu").rglob("*.py")):
+        if path.name == "__init__.py":
+            continue
+        module = str(path.relative_to(REPO))[:-3].replace("/", ".")
+        if not relaxed(module):
+            strict.add(module)
+    return strict
+
+
+def check_mypy_sync() -> list:
+    """Check 8: ANNOTATED == mypy.ini's strict module set, so the
+    runtime annotation gate (checks 3/5) covers exactly the modules
+    real CI would hold to strict mypy."""
+    annotated = {rel[:-3].replace("/", ".") for rel in ANNOTATED}
+    strict = _strict_mypy_modules()
+    problems = []
+    for module in sorted(strict - annotated):
+        problems.append(
+            f"mypy.ini: {module} is mypy-strict but missing from "
+            f"tools/lint.py ANNOTATED (add it, or relax it in "
+            f"mypy.ini with a reason)")
+    for module in sorted(annotated - strict):
+        problems.append(
+            f"tools/lint.py: {module} is in ANNOTATED but relaxed in "
+            f"mypy.ini (drop the override, or remove it from "
+            f"ANNOTATED)")
+    return problems
+
+
 def main() -> int:
     roots = [REPO / "mastic_tpu", REPO / "tests", REPO / "tools"]
     files = [REPO / "bench.py", REPO / "__graft_entry__.py"]
+    fixtures = REPO / "tests" / "fixtures"
     for root in roots:
-        files += sorted(root.rglob("*.py"))
+        files += sorted(p for p in root.rglob("*.py")
+                        if fixtures not in p.parents)
     problems = []
     for path in files:
         problems += check_file(path)
     problems += check_annotations_resolve()
     problems += check_call_signatures(files)
     problems += check_env_levers()
+    problems += check_mypy_sync()
     for problem in problems:
         print(problem)
     print(f"lint: {len(files)} files, {len(problems)} problem(s)")
