@@ -6,19 +6,27 @@
 #                installed) + fast-tier tests (scalar + kernel
 #                smokes; <5 min cold on a 1-CPU host with a warm
 #                compile cache)
-#   make analyze trace-safety / dtype / secret-flow / pallas static
-#                analyzer (tools/analysis/; rule table in USAGE.md) —
-#                exits non-zero on any unsuppressed finding
+#   make analyze trace-safety / dtype / secret-flow / pallas /
+#                robustness static analyzer (tools/analysis/; rule
+#                table in USAGE.md) — exits non-zero on any
+#                unsuppressed finding
+#   make faults  fault-matrix suite for the process-separated
+#                session layer (deadlines, injection, quarantine,
+#                respawn; USAGE.md "Fault model & injection") —
+#                fast tier only; the full-round matrix is slow-tier
 #   make test    full suite (adds the slow differential/adversarial/
 #                driver tiers)
 #   make bench   single-chip benchmark (prints one JSON line)
 
 PY ?= python
 
-.PHONY: ci lint analyze typecheck test-fast test test-slow \
+.PHONY: ci lint analyze faults typecheck test-fast test test-slow \
 	test-slow-1 test-slow-2 bench
 
-ci: lint analyze typecheck test-fast
+ci: lint analyze faults typecheck test-fast
+
+faults:
+	$(PY) -m pytest tests/test_faults.py -q -m "not slow"
 
 lint:
 	$(PY) tools/lint.py
@@ -35,8 +43,11 @@ typecheck:
 		     "scalar layer) - skipping"; \
 	fi
 
+# test_faults' fast tier already ran as its own `faults` gate right
+# after analyze — skip it here so `make ci` doesn't pay for it twice.
 test-fast:
-	$(PY) -m pytest tests/ -q -m "not slow"
+	$(PY) -m pytest tests/ -q -m "not slow" \
+		--ignore=tests/test_faults.py
 
 test-slow:
 	$(PY) -m pytest tests/ -q -m "slow"

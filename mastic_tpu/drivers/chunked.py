@@ -65,7 +65,9 @@ def _host_budget() -> int:
                 text = f.read().strip()
             if text.isdigit():
                 total = min(total, int(text))
-        except OSError:
+        # best-effort cgroup probe: an absent / unreadable limit file
+        # simply means no cgroup cap applies
+        except OSError:  # mastic-allow: RB002 — absence means no limit
             pass
     return int(total * 0.9)
 

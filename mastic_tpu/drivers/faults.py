@@ -1,0 +1,217 @@
+"""Deterministic fault injection for aggregation sessions
+(`MASTIC_FAULTS` lever; USAGE.md "Fault model & injection").
+
+The session layer's claims — bounded-time failure, party-attributed
+errors, no fault ever yielding a silently wrong aggregate — are only
+as good as the faults they were tested against.  This harness injects
+the faults real transports produce, deterministically, at two seams:
+
+* **outbound frames** (`FaultInjector.on_send`, called by
+  `session.Channel.send_msg` on the fully framed bytes): drop,
+  delay, truncate, corrupt, duplicate, hang — transport-level
+  mutations, so e.g. `truncate` leaves the receiver waiting on a
+  frame whose header promises more bytes than ever arrive;
+* **protocol checkpoints** (`FaultInjector.checkpoint`, called by the
+  party main loop and the collector between steps): kill (hard
+  process exit), hang, delay — crash-at-step faults.
+
+A fault spec is one or more `;`-separated rules:
+
+    <action>:party=<leader|helper|collector>:step=<step>[:nth=N]
+            [:delay=SECONDS][:cut=BYTES][:xor=BYTE][:offset=BYTES]
+
+e.g. ``kill:party=helper:step=round_start`` or
+``corrupt:party=leader:step=prep_share:offset=4:xor=1``.  `nth` is the
+1-based occurrence of the (party, step) event the rule fires on
+(default 1); each rule fires exactly once, so injection is
+deterministic and replayable.  Step names are the wire labels of
+drivers/parties.py (hello, leader_port, upload, upload_report,
+upload_ack, agg_param, prep_share, resolution, agg_share, shutdown)
+plus the process checkpoints (spawn, reports_loaded, round_start,
+prep_done, resolve_done, confirm_done).
+
+Each process parses `MASTIC_FAULTS` itself and keeps only the rules
+addressed to its own party name, so one env var arms the whole
+session (the collector passes it through to the party processes).
+"""
+
+import os
+import struct
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+ACTIONS = ("drop", "delay", "truncate", "corrupt", "duplicate",
+           "hang", "kill")
+PARTIES = ("leader", "helper", "collector")
+
+# `hang` sleeps this long — far past any configured deadline, short
+# enough that an orphaned hung process eventually dies on its own.
+HANG_SECONDS = 3600.0
+
+# Exit code a killed party dies with (distinct from 1 = structured
+# session error, so the collector can tell "injected kill" from
+# "party hit an error" in test assertions).
+KILL_EXIT_CODE = 17
+
+
+@dataclass
+class FaultRule:
+    action: str
+    party: str
+    step: str
+    nth: int = 1
+    delay: float = 5.0     # seconds, for delay
+    cut: int = 1           # trailing bytes removed, for truncate
+    xor: int = 0x01        # byte mask, for corrupt
+    offset: int = 4        # frame offset for corrupt (4 = first
+    #                        payload byte; 0..3 hits the length header)
+    fired: bool = field(default=False, repr=False)
+
+
+def parse_faults(text: Optional[str]) -> list:
+    """Parse a `;`-separated MASTIC_FAULTS spec into FaultRules.
+    Unknown actions/parties/keys are errors: a typo'd fault spec that
+    silently injects nothing would make the whole matrix vacuous."""
+    rules = []
+    for chunk in (text or "").split(";"):
+        chunk = chunk.strip()
+        if not chunk:
+            continue
+        parts = chunk.split(":")
+        action = parts[0].strip()
+        if action not in ACTIONS:
+            raise ValueError(
+                f"unknown fault action {action!r} (must be one of "
+                f"{', '.join(ACTIONS)})")
+        kwargs: dict = {}
+        for kv in parts[1:]:
+            if "=" not in kv:
+                raise ValueError(f"malformed fault field {kv!r} "
+                                 f"(want key=value)")
+            (key, val) = kv.split("=", 1)
+            key = key.strip()
+            val = val.strip()
+            if key == "party":
+                if val not in PARTIES:
+                    raise ValueError(
+                        f"unknown fault party {val!r} (must be one "
+                        f"of {', '.join(PARTIES)})")
+                kwargs["party"] = val
+            elif key == "step":
+                kwargs["step"] = val
+            elif key in ("nth", "cut", "offset"):
+                kwargs[key] = int(val)
+            elif key == "delay":
+                kwargs[key] = float(val)
+            elif key == "xor":
+                kwargs[key] = int(val, 0) & 0xFF
+            else:
+                raise ValueError(f"unknown fault field {key!r}")
+        if "party" not in kwargs or "step" not in kwargs:
+            raise ValueError(
+                f"fault rule {chunk!r} needs party= and step=")
+        rules.append(FaultRule(action=action, **kwargs))
+    return rules
+
+
+class FaultInjector:
+    """Applies the rules addressed to one party.  Counting is per
+    (rule), matched against this party's (step) events in order, so a
+    spec replays identically run to run."""
+
+    def __init__(self, rules: list, party: str):
+        self.party = party
+        self.rules = [r for r in rules if r.party == party]
+        self._event_counts: dict = {}
+
+    def _match(self, step: str) -> Optional[FaultRule]:
+        """One event of (party, step) happened; the rule whose nth it
+        is fires.  Events are counted per step regardless of whether
+        any rule fires, so several rules can target different
+        occurrences of the same step."""
+        n = self._event_counts.get(step, 0) + 1
+        self._event_counts[step] = n
+        for rule in self.rules:
+            if rule.step == step and not rule.fired and rule.nth == n:
+                rule.fired = True
+                return rule
+        return None
+
+    # -- outbound frames (Channel.send_msg) ------------------------
+
+    def on_send(self, step: str, frame: bytes) -> list:
+        """Transform one outbound frame (header + payload) into the
+        list of byte strings actually written."""
+        rule = self._match(step)
+        if rule is None:
+            return [frame]
+        if rule.action == "drop":
+            return []
+        if rule.action == "duplicate":
+            return [frame, frame]
+        if rule.action == "truncate":
+            return [frame[:max(0, len(frame) - rule.cut)]]
+        if rule.action == "corrupt":
+            off = min(rule.offset, len(frame) - 1)
+            mutated = bytearray(frame)
+            mutated[off] ^= (rule.xor or 0x01)
+            return [bytes(mutated)]
+        if rule.action == "delay":
+            time.sleep(rule.delay)
+            return [frame]
+        if rule.action == "hang":
+            time.sleep(HANG_SECONDS)
+            return [frame]
+        if rule.action == "kill":
+            os._exit(KILL_EXIT_CODE)
+        raise AssertionError(f"unhandled fault action {rule.action}")
+
+    # -- protocol checkpoints --------------------------------------
+
+    def checkpoint(self, step: str) -> None:
+        """Crash-at-step seam: kill/hang/delay fire here; the frame
+        mutations are meaningless between messages and ignored."""
+        rule = self._match(step)
+        if rule is None:
+            return
+        if rule.action == "kill":
+            os._exit(KILL_EXIT_CODE)
+        elif rule.action == "hang":
+            time.sleep(HANG_SECONDS)
+        elif rule.action == "delay":
+            time.sleep(rule.delay)
+
+    def split_report_blob(self, step: str, blob: bytes) -> bytes:
+        """Content-level mutation of ONE report blob inside the upload
+        body (quarantine-path testing): truncate/corrupt apply to the
+        bare blob, not a frame — so `offset` counts from byte 0."""
+        rule = self._match(step)
+        if rule is None:
+            return blob
+        if rule.action == "truncate":
+            return blob[:max(0, len(blob) - rule.cut)]
+        if rule.action == "corrupt":
+            off = min(rule.offset, len(blob) - 1)
+            mutated = bytearray(blob)
+            mutated[off] ^= (rule.xor or 0x01)
+            return bytes(mutated)
+        raise ValueError(
+            f"fault action {rule.action!r} does not apply to "
+            f"step {step!r} (use truncate or corrupt)")
+
+
+def injector_from_env(party: str) -> Optional[FaultInjector]:
+    """The process's injector, or None when MASTIC_FAULTS is unset /
+    names no rule for this party (the common, zero-overhead case)."""
+    spec = os.environ.get("MASTIC_FAULTS")
+    if not spec:
+        return None
+    inj = FaultInjector(parse_faults(spec), party)
+    return inj if inj.rules else None
+
+
+def frame_of(payload: bytes) -> bytes:
+    """The framed form of a payload (for tests asserting what a fault
+    does to the wire bytes)."""
+    return struct.pack("<I", len(payload)) + payload

@@ -7,7 +7,7 @@ specified, though, and this module runs them over an actual transport:
 
     collector ──spawn──> leader (agg 0)     helper (agg 1)
         │ upload: nonce‖public share‖input share   (per party view)
-        │ round:  encoded agg param
+        │ round:  encoded agg param ‖ quarantine mask
         │                  ▲
         │   helper ──prep share blob──> leader
         │   leader ──accept bitmap + prep msgs──> helper
@@ -21,6 +21,25 @@ rejection sampling fires are recomputed through the party's own
 scalar path before the exchange, so the fallback never crosses a
 trust boundary.
 
+Fault tolerance (ISSUE 3; the session layer in drivers/session.py):
+
+* every blocking call carries a deadline (per-exchange timeout plus a
+  session-level round budget), so a dead or hung peer fails the round
+  in bounded time with a `SessionError` naming the party and step;
+* a party that hits a protocol error NAKs the collector with a
+  structured error frame before exiting, so attribution does not have
+  to wait out the deadline;
+* a malformed report blob is *quarantined* (that report is excluded
+  from the batch with a reason code, both parties agree via the
+  collector's union mask) instead of aborting the upload;
+* the idempotent exchanges (upload, agg-param dispatch, agg-share
+  fetch) retry with bounded backoff; prep shares are recomputable
+  from the marshaled arrays, so `AggregationSession` restarts a whole
+  round after respawning a crashed party and the rerun is
+  bit-identical;
+* every outcome (timeouts, retries, quarantines, respawns) lands in
+  `RoundMetrics` counters.
+
 The DAP-style topology: the helper only talks to the leader for prep;
 the collector only sees aggregate shares (plus the leader's accept
 count) — reference README's deployment sketch and SURVEY.md §2.3's
@@ -28,9 +47,11 @@ communication-backend plan.
 """
 
 import json
+import os
 import socket
 import subprocess
 import sys
+import time
 from typing import Optional
 
 import numpy as np
@@ -38,16 +59,32 @@ import numpy as np
 from .. import mastic as mastic_mod
 from ..mastic import Mastic
 from .. import wire
+from ..metrics import RoundMetrics, count_round_bytes
+from . import faults as faults_mod
+from . import session as session_mod
+from .session import (Channel, Deadline, SessionConfig, SessionError,
+                      with_retries)
+
+# Collector -> party command bytes.
+CMD_UPLOAD = b"\x01"
+CMD_ROUND = b"\x02"
+CMD_SHUTDOWN = b"\x03"
+# Party -> collector reply framing: ACK prefix + payload, or NAK
+# prefix + a JSON-encoded structured error (party/step/kind/detail).
+REPLY_ACK = b"\x06"
+REPLY_NAK = b"\x15"
+
+# Quarantine reason codes (the per-report rejection taxonomy the
+# upload ack reports; names in REASON_NAMES for metrics/debugging).
+REASON_MALFORMED = 1      # decode raised: bad length / framing
+REASON_RANGE = 2          # decoded but out of range (field element)
+REASON_NAMES = {REASON_MALFORMED: "malformed", REASON_RANGE: "range"}
 
 
 def instantiate(spec: dict) -> Mastic:
     """{"class": "MasticCount", "args": [2]} -> instance."""
     cls = getattr(mastic_mod, spec["class"])
     return cls(*spec["args"])
-
-
-def _channel(sock: socket.socket):
-    return sock.makefile("rwb")
 
 
 class AggregatorParty:
@@ -63,17 +100,42 @@ class AggregatorParty:
         self.ctx = ctx
         self.bm = BatchedMastic(mastic)
         self.reports: list = []
+        self.quarantined: list = []   # [(index, reason code, detail)]
         self.arrays: Optional[dict] = None
         self._prep = None
         self._resolve_fns: dict = {}
 
     # -- upload channel --------------------------------------------
 
-    def load_reports(self, blobs: list[bytes]) -> None:
-        self.reports = [wire.decode_report(self.m, self.agg_id, blob)
-                        for blob in blobs]
+    def load_reports(self, blobs: list[bytes]) -> list:
+        """Decode the upload blobs; a malformed blob quarantines that
+        report (returned as (index, reason, detail)) instead of
+        aborting the batch — the lane is padded with a copy of the
+        first good report and masked out of every later stage.
+        Raises ValueError when no report decodes (there is no batch
+        to pad)."""
+        decoded: list = []
+        quarantined: list = []
+        for (i, blob) in enumerate(blobs):
+            try:
+                decoded.append(wire.decode_report(self.m, self.agg_id,
+                                                  blob))
+            except (ValueError, EOFError) as exc:
+                reason = (REASON_RANGE
+                          if "out of range" in str(exc)
+                          else REASON_MALFORMED)
+                quarantined.append((i, reason, str(exc)))
+                decoded.append(None)
+        good = next((r for r in decoded if r is not None), None)
+        if good is None:
+            raise ValueError(
+                f"all {len(blobs)} uploaded reports are malformed — "
+                f"no batch to aggregate")
+        self.reports = [r if r is not None else good for r in decoded]
+        self.quarantined = quarantined
         self.arrays = self.bm.marshal_party_reports(self.agg_id,
                                                     self.reports)
+        return quarantined
 
     # -- prep ------------------------------------------------------
 
@@ -82,7 +144,11 @@ class AggregatorParty:
         R fixed-size rows (eval proof ‖ [jr part] ‖ [verifier])."""
         import jax
 
-        assert self.arrays is not None
+        if self.arrays is None:
+            raise SessionError(
+                "leader" if self.agg_id == 0 else "helper",
+                "agg_param", session_mod.KIND_PROTOCOL,
+                "round requested before any report upload")
         a = self.arrays
         bm = self.bm
         fn = jax.jit(lambda n, c, k, p, s, j: bm.prep(
@@ -143,7 +209,8 @@ class AggregatorParty:
 
     # -- leader: the prep-share exchange ---------------------------
 
-    def resolve(self, agg_param, peer_blob: bytes) -> tuple:
+    def resolve(self, agg_param, peer_blob: bytes,
+                exclude: Optional[np.ndarray] = None) -> tuple:
         """Leader side of prep_shares_to_prep over the report batch:
         returns (accept bitmap bytes, prep-msg blob).
 
@@ -154,7 +221,9 @@ class AggregatorParty:
         joint-rand seed derivation all run as single batched ops.  A
         verifier element outside the field (possible only from a
         misbehaving helper) rejects that report instead of aborting
-        the batch."""
+        the batch.  `exclude` masks quarantined lanes (the
+        collector's union mask) out of acceptance before the bitmap
+        is built."""
         import jax.numpy as jnp
 
         (_level, _prefixes, do_wc) = agg_param
@@ -177,9 +246,11 @@ class AggregatorParty:
                 p.joint_rand_part, p.joint_rand_seed)
         else:
             (accept, prep_msgs) = fn(jnp.asarray(peer), p.eval_proof)
-        accept = np.asarray(accept)
+        accept = np.asarray(accept).copy()
         prep_msgs = (np.asarray(prep_msgs) if prep_msgs is not None
                      else None)
+        if exclude is not None:
+            accept &= ~np.asarray(exclude, bool)
 
         bitmap = np.packbits(accept, bitorder="little").tobytes()
         blob = b"".join(
@@ -269,7 +340,10 @@ class AggregatorParty:
             if not accept[r]:
                 continue
             if use_jr:
-                assert jr_seed is not None
+                if jr_seed is None:
+                    raise ValueError(
+                        "malformed resolution from leader: prep msg "
+                        "present but this round has no joint rand")
                 if msg != jr_seed[r].tobytes():
                     accept[r] = False  # joint-rand confirmation failed
             elif msg != b"":
@@ -293,6 +367,64 @@ class AggregatorParty:
             self.bm.spec.plain_to_le_bytes(agg)).tobytes()
 
 
+# -- quarantine ack / round-command codecs ----------------------------
+
+def encode_quarantine(entries: list) -> bytes:
+    """(index, reason, detail) list -> compact ack payload (details
+    stay party-local; the wire carries index + reason code)."""
+    out = [np.uint32(len(entries)).tobytes()]
+    for (idx, reason, _detail) in entries:
+        out.append(np.uint32(idx).tobytes() + bytes([reason]))
+    return b"".join(out)
+
+
+def decode_quarantine(payload: bytes) -> list:
+    if len(payload) < 4:
+        raise ValueError("malformed upload ack: truncated count")
+    (num,) = np.frombuffer(payload[:4], np.uint32)
+    body = payload[4:]
+    if len(body) != int(num) * 5:
+        raise ValueError(
+            f"malformed upload ack: {len(body)} bytes for "
+            f"{int(num)} quarantine entries")
+    entries = []
+    for i in range(int(num)):
+        (idx,) = np.frombuffer(body[i * 5:i * 5 + 4], np.uint32)
+        entries.append((int(idx), body[i * 5 + 4]))
+    return entries
+
+
+def encode_round_cmd(encoded_param: bytes, mask: np.ndarray) -> bytes:
+    """CMD_ROUND ‖ u32 param length ‖ param ‖ quarantine mask bits."""
+    mask_bytes = np.packbits(np.asarray(mask, bool),
+                             bitorder="little").tobytes()
+    return (CMD_ROUND + np.uint32(len(encoded_param)).tobytes()
+            + encoded_param + mask_bytes)
+
+
+def decode_round_cmd(msg: bytes, num_reports: int) -> tuple:
+    """-> (encoded agg param, quarantine mask over num_reports)."""
+    if len(msg) < 5:
+        raise ValueError("malformed round command: truncated header")
+    (plen,) = np.frombuffer(msg[1:5], np.uint32)
+    plen = int(plen)
+    if len(msg) < 5 + plen:
+        raise ValueError(
+            f"malformed round command: param needs {plen} bytes, "
+            f"{len(msg) - 5} present")
+    encoded_param = msg[5:5 + plen]
+    mask_bytes = msg[5 + plen:]
+    need = (num_reports + 7) // 8
+    if len(mask_bytes) != need:
+        raise ValueError(
+            f"malformed round command: quarantine mask is "
+            f"{len(mask_bytes)} bytes, want {need}")
+    mask = np.unpackbits(
+        np.frombuffer(mask_bytes, np.uint8),
+        bitorder="little")[:num_reports].astype(bool)
+    return (encoded_param, mask)
+
+
 # -- the party process main loop -------------------------------------
 
 def party_main(argv: list[str]) -> None:
@@ -300,8 +432,6 @@ def party_main(argv: list[str]) -> None:
     # to the remote TPU backend; make the caller's JAX_PLATFORMS
     # authoritative again (the test fabric runs parties on CPU, and a
     # down TPU tunnel must not be able to hang a CPU party).
-    import os
-
     import jax
 
     requested = os.environ.get("JAX_PLATFORMS", "").strip()
@@ -318,165 +448,755 @@ def party_main(argv: list[str]) -> None:
 
     cfg = json.loads(argv[0])
     agg_id = cfg["agg_id"]
+    me = "leader" if agg_id == 0 else "helper"
+    config = SessionConfig.from_env()
+    injector = faults_mod.injector_from_env(me)
 
     def trace(what: str) -> None:
         if debug:
             print(f"[party {agg_id}] {what}", file=sys.stderr,
                   flush=True)
 
+    def checkpoint(step: str) -> None:
+        if injector is not None:
+            injector.checkpoint(step)
+
+    checkpoint("spawn")
     mastic = instantiate(cfg["mastic"])
     party = AggregatorParty(mastic, agg_id,
                             bytes.fromhex(cfg["verify_key"]),
                             bytes.fromhex(cfg["ctx"]))
     trace("engine up, connecting")
 
-    coll_sock = socket.create_connection(("127.0.0.1",
-                                          cfg["collector_port"]))
-    coll = _channel(coll_sock)
-    wire.send_msg(coll, bytes([agg_id]))
+    coll = session_mod.connect(
+        "127.0.0.1", cfg["collector_port"], "collector",
+        config.connect_timeout, config.exchange_timeout, injector)
+    try:
+        _party_loop(party, coll, config, injector, trace, checkpoint)
+    except SessionError as err:
+        trace(f"session error: {err}")
+        nak = json.dumps({"party": err.party, "step": err.step,
+                          "kind": err.kind,
+                          "detail": err.detail}).encode()
+        try:
+            coll.send_msg(REPLY_NAK + nak, "nak")
+        except SessionError:
+            trace("collector unreachable for the error report")
+        sys.exit(1)
 
-    peer = None
+
+def _party_loop(party: AggregatorParty, coll: Channel,
+                config: SessionConfig, injector, trace,
+                checkpoint) -> None:
+    agg_id = party.agg_id
+    mastic = party.m
+    coll.send_msg(bytes([agg_id]), "hello")
+
     if agg_id == 0:
         lst = socket.create_server(("127.0.0.1", 0))
-        wire.send_msg(coll, lst.getsockname()[1].to_bytes(2, "little"))
+        coll.send_msg(lst.getsockname()[1].to_bytes(2, "little"),
+                      "leader_port")
         trace("listening for helper")
-        (peer_sock, _) = lst.accept()
-        peer = _channel(peer_sock)
+        peer = session_mod.accept(lst, "helper",
+                                  config.connect_timeout,
+                                  config.exchange_timeout, injector)
+        lst.close()
     else:
-        port_msg = wire.recv_msg(coll)
-        assert port_msg is not None
-        peer_sock = socket.create_connection(
-            ("127.0.0.1", int.from_bytes(port_msg, "little")))
-        peer = _channel(peer_sock)
+        port_msg = coll.recv_msg("leader_port")
+        if port_msg is None or len(port_msg) != 2:
+            raise SessionError("collector", "leader_port",
+                               session_mod.KIND_CLOSED,
+                               "no leader port from collector")
+        peer = session_mod.connect(
+            "127.0.0.1", int.from_bytes(port_msg, "little"), "leader",
+            config.connect_timeout, config.exchange_timeout, injector)
     trace("peer channel up")
 
     while True:
-        msg = wire.recv_msg(coll)
-        if msg is None or msg[:1] == b"\x03":
+        # Idle wait for the next command: bounded by the round
+        # deadline, not the (shorter) exchange timeout — a collector
+        # pacing rounds or retrying an upload is normal; a collector
+        # that DIED closes the socket and lands here as None at once.
+        msg = coll.recv_msg("command", timeout=config.round_deadline)
+        if msg is None or msg[:1] == CMD_SHUTDOWN:
             trace("shutdown")
             break
-        if msg[:1] == b"\x01":  # upload
-            body = msg[1:]
-            (num,) = np.frombuffer(body[:4], np.uint32)
-            rest = body[4:]
-            blobs = []
-            for _ in range(int(num)):
-                (blob, rest) = wire.unframe(rest)
-                blobs.append(blob)
-            party.load_reports(blobs)
-            trace(f"loaded {num} reports")
-            wire.send_msg(coll, b"ok")
-        elif msg[:1] == b"\x02":  # one aggregation round
-            agg_param = mastic.decode_agg_param(msg[1:])
+        if msg[:1] == CMD_UPLOAD:  # upload
+            if len(msg) < 2:
+                raise SessionError("collector", "upload",
+                                   session_mod.KIND_MALFORMED,
+                                   "upload without a generation byte")
+            gen = msg[1:2]   # echoed in the ack so a retried upload
+            #                  cannot be satisfied by a stale ack
+            body = msg[2:]
+            try:
+                blobs = _parse_upload_body(body)
+                quarantined = party.load_reports(blobs)
+            except (ValueError, EOFError) as exc:
+                raise SessionError("collector", "upload",
+                                   session_mod.KIND_MALFORMED,
+                                   str(exc))
+            checkpoint("reports_loaded")
+            trace(f"loaded {len(party.reports)} reports "
+                  f"({len(quarantined)} quarantined)")
+            coll.send_msg(
+                REPLY_ACK + gen + encode_quarantine(quarantined),
+                "upload_ack")
+        elif msg[:1] == CMD_ROUND:  # one aggregation round
+            try:
+                (encoded_param, mask) = decode_round_cmd(
+                    msg, len(party.reports))
+                agg_param = mastic.decode_agg_param(encoded_param)
+            except (ValueError, EOFError) as exc:
+                raise SessionError("collector", "agg_param",
+                                   session_mod.KIND_MALFORMED,
+                                   str(exc))
+            checkpoint("round_start")
             trace(f"round level={agg_param[0]} compiling prep")
             blob = party.prep_blob(agg_param)
+            checkpoint("prep_done")
             trace("prep done, exchanging")
             if agg_id == 1:
-                wire.send_msg(peer, blob)
-                resolution = wire.recv_msg(peer)
-                assert resolution is not None
-                accept = party.confirm(agg_param, resolution)
-                wire.send_msg(coll, party.agg_share(agg_param, accept))
+                peer.send_msg(blob, "prep_share")
+                resolution = peer.recv_msg("resolution")
+                if resolution is None:
+                    raise SessionError("leader", "resolution",
+                                       session_mod.KIND_CLOSED,
+                                       "leader closed before the "
+                                       "resolution")
+                try:
+                    accept = party.confirm(agg_param, resolution)
+                except ValueError as exc:
+                    raise SessionError("leader", "resolution",
+                                       session_mod.KIND_MALFORMED,
+                                       str(exc))
+                accept &= ~mask
+                checkpoint("confirm_done")
+                coll.send_msg(
+                    REPLY_ACK + party.agg_share(agg_param, accept),
+                    "agg_share")
             else:
-                peer_blob = wire.recv_msg(peer)
-                assert peer_blob is not None
-                (accept, resolution) = party.resolve(agg_param,
-                                                     peer_blob)
-                wire.send_msg(peer, resolution)
+                peer_blob = peer.recv_msg("prep_share")
+                if peer_blob is None:
+                    raise SessionError("helper", "prep_share",
+                                       session_mod.KIND_CLOSED,
+                                       "helper closed before its "
+                                       "prep share")
+                try:
+                    (accept, resolution) = party.resolve(
+                        agg_param, peer_blob, exclude=mask)
+                except ValueError as exc:
+                    raise SessionError("helper", "prep_share",
+                                       session_mod.KIND_MALFORMED,
+                                       str(exc))
+                checkpoint("resolve_done")
+                peer.send_msg(resolution, "resolution")
                 bitmap = np.packbits(accept,
                                      bitorder="little").tobytes()
-                wire.send_msg(coll, bitmap
-                              + party.agg_share(agg_param, accept))
+                coll.send_msg(
+                    REPLY_ACK + bitmap
+                    + party.agg_share(agg_param, accept),
+                    "agg_share")
             trace("round done")
+        else:
+            raise SessionError("collector", "command",
+                               session_mod.KIND_PROTOCOL,
+                               f"unknown command byte "
+                               f"{msg[:1].hex()}")
+
+
+def _parse_upload_body(body: bytes) -> list:
+    if len(body) < 4:
+        raise ValueError("malformed upload: truncated report count")
+    (num,) = np.frombuffer(body[:4], np.uint32)
+    rest = body[4:]
+    blobs = []
+    for i in range(int(num)):
+        try:
+            (blob, rest) = wire.unframe(rest)
+        except ValueError as exc:
+            raise ValueError(
+                f"malformed upload: report frame {i} of {int(num)}: "
+                f"{exc}")
+        blobs.append(blob)
+    if rest:
+        raise ValueError(
+            f"malformed upload: {len(rest)} trailing bytes after "
+            f"the last report frame")
+    return blobs
 
 
 # -- collector side --------------------------------------------------
 
 class ProcessCollector:
     """Spawns the two aggregator processes and drives rounds against
-    them; the in-process analog is drivers/heavy_hitters.run_round."""
+    them; the in-process analog is drivers/heavy_hitters.run_round.
+
+    One spawn generation: a transport fault surfaces as a
+    `SessionError` attributed to a party and step.  `respawn()` tears
+    the pair down and rebuilds it (replaying the stored upload), which
+    is how `AggregationSession` survives a crashed party.
+    """
 
     def __init__(self, mastic: Mastic, mastic_spec: dict, ctx: bytes,
-                 verify_key: bytes):
+                 verify_key: bytes,
+                 config: Optional[SessionConfig] = None,
+                 faults_spec: Optional[str] = None):
         self.m = mastic
+        self.spec = mastic_spec
+        self.ctx = ctx
+        self.verify_key = verify_key
+        self.config = config or SessionConfig.from_env()
+        self.faults_spec = faults_spec
+        self.injector = (
+            faults_mod.FaultInjector(
+                faults_mod.parse_faults(faults_spec), "collector")
+            if faults_spec is not None
+            else faults_mod.injector_from_env("collector"))
+        self.counters = {"timeouts": 0, "retries": 0, "respawns": 0,
+                         "quarantined": 0}
+        self.quarantine: dict = {}       # report index -> reason code
+        self.num_reports = 0
+        self._upload_bodies: Optional[list] = None
+        self._upload_gen = 0
+        # Injected party faults are one-generation: a respawned pair
+        # comes up clean (otherwise a kill-at-step fault would kill
+        # every respawn and recovery could never be tested or used).
+        self._arm_child_faults = True
+        self.procs: list = []
+        self.server: Optional[socket.socket] = None
+        self.leader: Optional[Channel] = None
+        self.helper: Optional[Channel] = None
+        try:
+            self._spawn()
+        except SessionError:
+            # A failed handshake must not leak the surviving party
+            # process or the server port.
+            self._teardown(kill=True)
+            raise
+
+    # -- spawn / teardown / respawn --------------------------------
+
+    def _spawn(self) -> None:
+        cfg = self.config
         self.server = socket.create_server(("127.0.0.1", 0))
         port = self.server.getsockname()[1]
-        env_cfg = {"mastic": mastic_spec, "ctx": ctx.hex(),
-                   "verify_key": verify_key.hex(),
+        env_cfg = {"mastic": self.spec, "ctx": self.ctx.hex(),
+                   "verify_key": self.verify_key.hex(),
                    "collector_port": port}
+        env = {**os.environ, **self.config.child_env()}
+        if self.faults_spec is not None and self._arm_child_faults:
+            env["MASTIC_FAULTS"] = self.faults_spec
+        else:
+            env.pop("MASTIC_FAULTS", None)
         self.procs = [
             subprocess.Popen(
                 [sys.executable, "-m", "mastic_tpu.drivers.parties",
                  json.dumps({**env_cfg, "agg_id": agg_id})],
-                cwd=_repo_root(), stdout=sys.stderr, stderr=sys.stderr)
+                cwd=_repo_root(), env=env,
+                stdout=sys.stderr, stderr=sys.stderr)
             for agg_id in range(2)
         ]
-        chans = {}
+        chans: dict = {}
         for _ in range(2):
-            (sock, _addr) = self.server.accept()
-            chan = _channel(sock)
-            hello = wire.recv_msg(chan)
-            assert hello is not None
+            try:
+                chan = session_mod.accept(
+                    self.server, "party", cfg.connect_timeout,
+                    cfg.exchange_timeout, self.injector)
+                hello = chan.recv_msg("hello")
+            except SessionError as err:
+                raise self._attributed(err)
+            if hello is None or len(hello) != 1 \
+                    or hello[0] not in (0, 1):
+                raise SessionError(
+                    "party", "hello", session_mod.KIND_MALFORMED,
+                    f"bad hello {hello!r}")
+            if hello[0] in chans:
+                raise SessionError(
+                    "leader" if hello[0] == 0 else "helper", "hello",
+                    session_mod.KIND_PROTOCOL, "duplicate hello")
+            chan.remote = "leader" if hello[0] == 0 else "helper"
             chans[hello[0]] = chan
         (self.leader, self.helper) = (chans[0], chans[1])
-        leader_port = wire.recv_msg(self.leader)
-        assert leader_port is not None
-        wire.send_msg(self.helper, leader_port)
+        try:
+            leader_port = self.leader.recv_msg("leader_port")
+        except SessionError as err:
+            raise self._attributed(err)
+        if leader_port is None:
+            raise SessionError("leader", "leader_port",
+                               session_mod.KIND_CLOSED,
+                               "leader closed before sending its "
+                               "peer port")
+        self.helper.send_msg(leader_port, "leader_port")
+
+    def _teardown(self, kill: bool = False) -> None:
+        for chan in (self.leader, self.helper):
+            if chan is not None:
+                chan.close()
+        (self.leader, self.helper) = (None, None)
+        for proc in self.procs:
+            if proc.poll() is None:
+                if kill:
+                    proc.kill()
+                else:
+                    proc.terminate()
+                try:
+                    proc.wait(timeout=self.config.shutdown_timeout)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait()
+        self.procs = []
+        if self.server is not None:
+            self.server.close()
+            self.server = None
+
+    def respawn(self) -> None:
+        """Kill and rebuild the party pair, replaying the stored
+        upload — the crash-recovery path.  Prep state is recomputed
+        from the replayed reports, so a rerun round is bit-identical
+        to an unfaulted one."""
+        self.counters["respawns"] += 1
+        self._teardown(kill=True)
+        self._arm_child_faults = False
+        try:
+            self._spawn()
+        except SessionError:
+            self._teardown(kill=True)
+            raise
+        if self._upload_bodies is not None:
+            self._send_upload()
+
+    def _party_status(self) -> str:
+        out = []
+        for (name, proc) in zip(("leader", "helper"), self.procs):
+            rc = proc.poll()
+            out.append(f"{name}: "
+                       + ("running" if rc is None
+                          else f"exited rc={rc}"))
+        return "; ".join(out) if out else "no processes"
+
+    def _attributed(self, err: SessionError) -> SessionError:
+        """Sharpen a transport error with process liveness: a timeout
+        whose party is dead becomes a crash, attributed to the dead
+        party.  A party that exited rc=1 NAKed a structured error of
+        its own first — a harder death (kill, signal, injected exit)
+        is the better root cause when both are down."""
+        if err.kind in (session_mod.KIND_CLOSED,
+                        session_mod.KIND_TIMEOUT):
+            # A dying party closes its socket an instant before the
+            # kernel reaps it — give poll() a short grace window so
+            # the crash is attributed as a crash, not a closed chan.
+            grace = Deadline(0.5)
+            while not grace.expired() \
+                    and all(p.poll() is None for p in self.procs):
+                time.sleep(0.02)
+        dead = [(name, rc)
+                for (name, proc) in zip(("leader", "helper"),
+                                        self.procs)
+                for rc in [proc.poll()]
+                if rc is not None and rc != 0
+                and err.party in (name, "party")]
+        if dead:
+            hard = [d for d in dead if d[1] != 1]
+            (name, rc) = hard[0] if hard else dead[0]
+            return SessionError(
+                name, err.step, session_mod.KIND_CRASHED,
+                f"party process exited rc={rc} ({err.detail})")
+        if err.kind == session_mod.KIND_TIMEOUT:
+            self.counters["timeouts"] += 1
+        return SessionError(err.party, err.step, err.kind,
+                            f"{err.detail} [{self._party_status()}]")
+
+    # -- upload ----------------------------------------------------
 
     def upload(self, reports: list) -> None:
         """reports: [(nonce, public_share, input_shares)] with BOTH
         input shares (the collector here doubles as the upload relay —
-        clients talk to aggregators directly in a real deployment)."""
+        clients talk to aggregators directly in a real deployment).
+        Malformed report blobs are quarantined per report (reason
+        codes in `self.quarantine`), not fatal; the upload exchange
+        retries with backoff (it is idempotent: parties reload the
+        batch wholesale)."""
         self.num_reports = len(reports)
-        for (agg_id, chan) in ((0, self.leader), (1, self.helper)):
-            blobs = [
-                wire.encode_report(self.m, agg_id, nonce, ps,
-                                   shares[agg_id])
-                for (nonce, ps, shares) in reports
-            ]
-            body = np.uint32(len(blobs)).tobytes() \
-                + b"".join(wire.frame(b) for b in blobs)
-            wire.send_msg(chan, b"\x01" + body)
-        for chan in (self.leader, self.helper):
-            assert wire.recv_msg(chan) == b"ok"
+        bodies = []
+        for agg_id in range(2):
+            blobs = []
+            for (nonce, ps, shares) in reports:
+                blob = wire.encode_report(self.m, agg_id, nonce, ps,
+                                          shares[agg_id])
+                if self.injector is not None:
+                    blob = self.injector.split_report_blob(
+                        "upload_report", blob)
+                blobs.append(blob)
+            bodies.append(np.uint32(len(blobs)).tobytes()
+                          + b"".join(wire.frame(b) for b in blobs))
+        self._upload_bodies = bodies
+        self._send_upload()
 
-    def round(self, agg_param) -> tuple:
-        """Run one aggregation round; returns (agg_result, accept)."""
-        encoded = b"\x02" + self.m.encode_agg_param(agg_param)
-        wire.send_msg(self.leader, encoded)
-        wire.send_msg(self.helper, encoded)
-        leader_msg = wire.recv_msg(self.leader)
-        helper_msg = wire.recv_msg(self.helper)
-        assert leader_msg is not None and helper_msg is not None
+    def upload_encoded(self, bodies: list, num_reports: int) -> None:
+        """Replay path (AggregationSession resume): upload
+        pre-encoded per-party bodies verbatim."""
+        self.num_reports = num_reports
+        self._upload_bodies = list(bodies)
+        self._send_upload()
+
+    def _send_upload(self) -> None:
+        cfg = self.config
+
+        def attempt():
+            self.quarantine = {}
+            self._upload_gen = (self._upload_gen + 1) % 256
+            gen = bytes([self._upload_gen])
+            try:
+                for (chan, body) in ((self.leader,
+                                      self._upload_bodies[0]),
+                                     (self.helper,
+                                      self._upload_bodies[1])):
+                    chan.send_msg(CMD_UPLOAD + gen + body, "upload")
+                for chan in (self.leader, self.helper):
+                    ack = self._recv_ack(chan, gen)
+                    for (idx, reason) in decode_quarantine(ack):
+                        self.quarantine[idx] = reason
+            except SessionError as err:
+                raise self._attributed(err)
+
+        with_retries(attempt, cfg.retries, cfg.backoff,
+                     on_retry=self._on_retry)
+        self.counters["quarantined"] = len(self.quarantine)
+        if len(self.quarantine) >= self.num_reports \
+                and self.num_reports > 0:
+            reasons = {k: REASON_NAMES.get(v, v)
+                       for (k, v) in sorted(self.quarantine.items())}
+            raise SessionError(
+                "collector", "upload", session_mod.KIND_PROTOCOL,
+                f"all {self.num_reports} reports quarantined "
+                f"(reasons: {reasons})")
+
+    def _on_retry(self, err: SessionError, attempt: int) -> None:
+        self.counters["retries"] += 1
+
+    def quarantine_mask(self) -> np.ndarray:
+        mask = np.zeros(self.num_reports, bool)
+        for idx in self.quarantine:
+            if idx < self.num_reports:
+                mask[idx] = True
+        return mask
+
+    def _recv_ack(self, chan: Channel, gen: bytes) -> bytes:
+        """One upload ack matching this attempt's generation byte; a
+        stale ack from a timed-out earlier attempt is discarded (the
+        resend is idempotent, but its ack must not be double-read)."""
+        deadline = Deadline(self.config.ack_timeout)
+        while True:
+            ack = self._recv_reply(chan, "upload_ack", deadline,
+                                   timeout=self.config.ack_timeout)
+            if len(ack) < 1:
+                raise SessionError(chan.remote, "upload_ack",
+                                   session_mod.KIND_MALFORMED,
+                                   "empty upload ack")
+            if ack[:1] == gen:
+                return ack[1:]
+            # stale generation: drop and keep the window open
+
+    def _recv_reply(self, chan: Channel, step: str,
+                    deadline: Optional[Deadline] = None,
+                    timeout: Optional[float] = None) -> bytes:
+        """One ACK payload; a NAK raises the party's own structured
+        error (attribution without waiting out the deadline)."""
+        msg = chan.recv_msg(step, deadline, timeout)
+        if msg is None:
+            raise SessionError(chan.remote, step,
+                               session_mod.KIND_CLOSED,
+                               "party closed the channel")
+        if msg[:1] == REPLY_NAK:
+            try:
+                err = json.loads(msg[1:])
+            except ValueError:
+                raise SessionError(chan.remote, step,
+                                   session_mod.KIND_MALFORMED,
+                                   "unparsable NAK")
+            raise SessionError(
+                err.get("party", chan.remote), err.get("step", step),
+                err.get("kind", session_mod.KIND_PROTOCOL),
+                f"(reported by {chan.remote}) {err.get('detail', '')}")
+        if msg[:1] != REPLY_ACK:
+            raise SessionError(chan.remote, step,
+                               session_mod.KIND_MALFORMED,
+                               f"bad reply prefix {msg[:1].hex()}")
+        return msg[1:]
+
+    # -- rounds ----------------------------------------------------
+
+    def round(self, agg_param,
+              metrics_out: Optional[list] = None) -> tuple:
+        """Run one aggregation round under the session deadline;
+        returns (agg_result, accept, (leader share, helper share)).
+        Timeout/retry/quarantine/respawn counters land in a
+        RoundMetrics appended to `metrics_out`."""
+        cfg = self.config
+        deadline = Deadline(cfg.round_deadline)
+        encoded = encode_round_cmd(self.m.encode_agg_param(agg_param),
+                                   self.quarantine_mask())
+        try:
+            self.leader.send_msg(encoded, "agg_param", deadline)
+            self.helper.send_msg(encoded, "agg_param", deadline)
+            # Round replies are governed by the round deadline alone:
+            # a party legitimately spends minutes in prep compile, and
+            # a party-side fault reaches us earlier as a NAK anyway.
+            leader_msg = self._recv_reply(
+                self.leader, "agg_share", deadline,
+                timeout=cfg.round_deadline)
+            helper_msg = self._recv_reply(
+                self.helper, "agg_share", deadline,
+                timeout=cfg.round_deadline)
+        except SessionError as err:
+            raise self._attributed(err)
         # leader payload: accept bitmap + agg share
         share_size = wire.agg_share_size(self.m, agg_param)
         nbytes = len(leader_msg) - share_size
         if nbytes != (self.num_reports + 7) // 8 \
                 or len(helper_msg) != share_size:
-            raise ValueError(
+            raise SessionError(
+                "leader" if nbytes != (self.num_reports + 7) // 8
+                else "helper",
+                "agg_share", session_mod.KIND_MALFORMED,
                 f"malformed round payload: leader sent "
                 f"{len(leader_msg)} bytes (want bitmap "
-                f"{(self.num_reports + 7) // 8} + share {share_size}), "
-                f"helper sent {len(helper_msg)} (want {share_size})")
+                f"{(self.num_reports + 7) // 8} + share {share_size}),"
+                f" helper sent {len(helper_msg)} (want {share_size})")
         accept = np.unpackbits(
             np.frombuffer(leader_msg[:nbytes], np.uint8),
             bitorder="little")[:self.num_reports].astype(bool)
+        accept &= ~self.quarantine_mask()
         agg0 = wire.decode_agg_share(self.m, agg_param,
                                      leader_msg[nbytes:])
         agg1 = wire.decode_agg_share(self.m, agg_param, helper_msg)
         num = int(accept.sum())
         result = self.m.unshard(agg_param, [agg0, agg1], num)
+        if metrics_out is not None:
+            metrics_out.append(self.round_metrics(agg_param, accept))
         return (result, accept, (leader_msg[nbytes:], helper_msg))
 
+    def round_metrics(self, agg_param,
+                      accept: np.ndarray) -> RoundMetrics:
+        """Session-cumulative fault counters + this round's verdict
+        and channel bytes (the process-separated driver cannot
+        attribute rejections to a specific check — the leader only
+        ships the final bitmap)."""
+        (level, prefixes, _wc) = agg_param
+        metrics = RoundMetrics(level=level,
+                               frontier_width=len(prefixes),
+                               padded_width=len(prefixes),
+                               reports_total=self.num_reports)
+        metrics.accepted = int(np.asarray(accept, bool).sum())
+        metrics.timeouts = self.counters["timeouts"]
+        metrics.retries = self.counters["retries"]
+        metrics.respawns = self.counters["respawns"]
+        metrics.quarantined = self.counters["quarantined"]
+        count_round_bytes(metrics, self.m, agg_param,
+                          self.num_reports)
+        metrics.extra["process_separated"] = True
+        metrics.extra["quarantine"] = {
+            str(idx): REASON_NAMES.get(code, code)
+            for (idx, code) in sorted(self.quarantine.items())}
+        return metrics
+
+    # -- teardown --------------------------------------------------
+
     def close(self) -> None:
-        for chan in (self.leader, self.helper):
+        """Graceful shutdown hardened against hung parties: a party
+        that ignores CMD_SHUTDOWN is terminated, then killed; the
+        server socket closes in a finally so a wedged party can
+        neither leak the port nor hang teardown."""
+        try:
+            for chan in (self.leader, self.helper):
+                if chan is None:
+                    continue
+                try:
+                    chan.send_msg(CMD_SHUTDOWN, "shutdown")
+                except SessionError:
+                    # A party that died earlier cannot ack shutdown;
+                    # count it so teardown stays observable.
+                    self.counters["shutdown_errors"] = \
+                        self.counters.get("shutdown_errors", 0) + 1
+            for proc in self.procs:
+                try:
+                    proc.wait(timeout=self.config.shutdown_timeout)
+                except subprocess.TimeoutExpired:
+                    proc.terminate()
+                    try:
+                        proc.wait(timeout=5)
+                    except subprocess.TimeoutExpired:
+                        proc.kill()
+                        proc.wait()
+        finally:
+            for chan in (self.leader, self.helper):
+                if chan is not None:
+                    chan.close()
+            if self.server is not None:
+                self.server.close()
+                self.server = None
+
+
+# -- supervised sessions: retry, respawn, snapshot, resume ------------
+
+_SNAPSHOT_VERSION = 1
+
+
+class AggregationSession:
+    """A supervised collection session over a ProcessCollector.
+
+    Adds the fault-tolerance policy on top of the mechanics: a failed
+    round (timeout, crash, malformed exchange) respawns the party
+    pair, replays the upload, and reruns the round — prep shares are
+    pure functions of the replayed reports, so the rerun aggregate is
+    bit-identical to an unfaulted run.  Completed rounds snapshot at
+    round boundaries (`to_bytes`), and `from_bytes` resumes a session
+    after a collector crash: it respawns parties, replays the stored
+    upload bodies, and replays completed rounds from the snapshot
+    instead of re-running them.
+    """
+
+    def __init__(self, mastic: Mastic, mastic_spec: dict, ctx: bytes,
+                 verify_key: bytes,
+                 config: Optional[SessionConfig] = None,
+                 faults_spec: Optional[str] = None):
+        self.m = mastic
+        self.spec = mastic_spec
+        self.ctx = ctx
+        self.verify_key = verify_key
+        self.config = config or SessionConfig.from_env()
+        self.coll = ProcessCollector(mastic, mastic_spec, ctx,
+                                     verify_key, self.config,
+                                     faults_spec)
+        # [(encoded agg param, result, accept, (share0, share1))]
+        self.completed: list = []
+        self._replay_index = 0
+
+    @property
+    def counters(self) -> dict:
+        return self.coll.counters
+
+    def upload(self, reports: list) -> None:
+        self.coll.upload(reports)
+
+    def round(self, agg_param,
+              metrics_out: Optional[list] = None) -> tuple:
+        """One round with bounded retry: a retryable SessionError
+        respawns the pair (replaying the upload) and reruns the
+        round.  A snapshot-resumed session replays completed rounds
+        from the snapshot (same agg params, in order) without
+        touching the parties."""
+        encoded = self.m.encode_agg_param(agg_param)
+        if self._replay_index < len(self.completed):
+            (saved_param, result, accept, shares) = \
+                self.completed[self._replay_index]
+            if saved_param != encoded:
+                raise SessionError(
+                    "collector", "agg_param",
+                    session_mod.KIND_PROTOCOL,
+                    "resumed session replayed a different agg param "
+                    "than the snapshot recorded")
+            self._replay_index += 1
+            if metrics_out is not None:
+                metrics_out.append(
+                    self.coll.round_metrics(agg_param, accept))
+            return (result, accept, shares)
+
+        attempt = 0
+        while True:
             try:
-                wire.send_msg(chan, b"\x03")
-            except (BrokenPipeError, OSError):
-                pass
-        for proc in self.procs:
-            proc.wait(timeout=60)
-        self.server.close()
+                (result, accept, shares) = self.coll.round(
+                    agg_param, metrics_out=metrics_out)
+                break
+            except SessionError as err:
+                if not err.retryable() \
+                        or attempt >= self.config.retries:
+                    raise
+                self.coll.counters["retries"] += 1
+                attempt += 1
+                self.coll.respawn()
+        self.completed.append((encoded, result, accept, shares))
+        self._replay_index = len(self.completed)
+        return (result, accept, shares)
+
+    def close(self) -> None:
+        self.coll.close()
+
+    # -- snapshot / resume (northstar.py checkpoint header pattern:
+    #    length-prefixed JSON binding header + npz payload) ---------
+
+    def to_bytes(self) -> bytes:
+        import io
+
+        header = json.dumps({
+            "version": _SNAPSHOT_VERSION,
+            "spec": self.spec,
+            "ctx": self.ctx.hex(),
+            "verify_key": self.verify_key.hex(),
+        }, sort_keys=True).encode()
+        data: dict = {
+            "meta": np.array([_SNAPSHOT_VERSION,
+                              self.coll.num_reports,
+                              len(self.completed)], np.int64),
+        }
+        bodies = self.coll._upload_bodies or [b"", b""]
+        for (i, body) in enumerate(bodies):
+            data[f"upload_{i}"] = np.frombuffer(body, np.uint8)
+        for (i, (param, result, accept, shares)) in \
+                enumerate(self.completed):
+            data[f"r{i}_param"] = np.frombuffer(param, np.uint8)
+            data[f"r{i}_result"] = np.frombuffer(
+                json.dumps(result).encode(), np.uint8)
+            data[f"r{i}_accept"] = np.asarray(accept, bool)
+            data[f"r{i}_share0"] = np.frombuffer(shares[0], np.uint8)
+            data[f"r{i}_share1"] = np.frombuffer(shares[1], np.uint8)
+        buf = io.BytesIO()
+        np.savez(buf, **data)
+        return (len(header).to_bytes(4, "little") + header
+                + buf.getvalue())
+
+    @classmethod
+    def from_bytes(cls, data: bytes,
+                   config: Optional[SessionConfig] = None,
+                   faults_spec: Optional[str] = None
+                   ) -> "AggregationSession":
+        import io
+
+        hlen = int.from_bytes(data[:4], "little")
+        try:
+            header = json.loads(data[4:4 + hlen])
+        except ValueError:
+            raise ValueError(
+                "session snapshot has no JSON binding header — not a "
+                "snapshot written by AggregationSession.to_bytes")
+        if header.get("version") != _SNAPSHOT_VERSION:
+            raise ValueError(
+                f"unknown session snapshot version "
+                f"{header.get('version')}")
+        arrays = np.load(io.BytesIO(data[4 + hlen:]),
+                         allow_pickle=False)
+        (_version, num_reports, num_rounds) = \
+            [int(x) for x in arrays["meta"]]
+        mastic = instantiate(header["spec"])
+        sess = cls(mastic, header["spec"],
+                   bytes.fromhex(header["ctx"]),
+                   bytes.fromhex(header["verify_key"]),
+                   config=config, faults_spec=faults_spec)
+        bodies = [arrays["upload_0"].tobytes(),
+                  arrays["upload_1"].tobytes()]
+        if num_reports:
+            sess.coll.upload_encoded(bodies, num_reports)
+        for i in range(num_rounds):
+            sess.completed.append((
+                arrays[f"r{i}_param"].tobytes(),
+                json.loads(arrays[f"r{i}_result"].tobytes()),
+                np.asarray(arrays[f"r{i}_accept"], bool),
+                (arrays[f"r{i}_share0"].tobytes(),
+                 arrays[f"r{i}_share1"].tobytes()),
+            ))
+        sess._replay_index = 0
+        return sess
 
 
 def _repo_root() -> str:

@@ -1,0 +1,325 @@
+"""Session layer for the process-separated parties: deadlines,
+structured errors, and bounded retry.
+
+Everything the transport can do to an aggregation session — a peer
+that hangs, dies, truncates, or floods — must surface as a
+`SessionError` naming the party and the protocol step, in bounded
+time.  This module is that contract:
+
+* `SessionError` — the one exception type the session layer raises for
+  transport/protocol faults, carrying (party, step, kind) so the
+  collector can attribute and the supervisor can decide retryability;
+* `Deadline` — a monotonic budget threaded through every blocking call
+  of a round, so N sequential exchanges share one bound instead of
+  multiplying per-call timeouts;
+* `SessionConfig` — the timeout/retry lever set (env levers
+  documented in USAGE.md "Fault model & injection");
+* `Channel` — a framed socket channel (same 4-byte LE length framing
+  as `wire.frame`) whose every send/recv takes a deadline; the only
+  place in the drivers that touches a raw socket read (the RB001
+  analyzer rule keeps it that way);
+* `with_retries` — bounded exponential backoff for the idempotent
+  exchanges (upload, agg-param dispatch, agg-share fetch — prep shares
+  are recomputable from the marshaled report arrays, so a round
+  restart is always safe).
+
+The fault-injection harness (`drivers/faults.py`) plugs in at the
+Channel seam: an injector mutates outbound frames and fires at
+protocol checkpoints, which is how the fault-matrix suite
+(tests/test_faults.py) drives every failure class through this layer.
+"""
+
+import os
+import socket
+import struct
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+# Error kinds a SessionError carries.  `timeout` and `closed` are the
+# retryable transport kinds (the peer may come back after a respawn);
+# `malformed`, `crashed` and `protocol` are terminal for the attempt
+# but still retryable at the session level after a respawn.
+KIND_TIMEOUT = "timeout"
+KIND_CLOSED = "closed"
+KIND_MALFORMED = "malformed"
+KIND_CRASHED = "crashed"
+KIND_PROTOCOL = "protocol"
+
+RETRYABLE_KINDS = (KIND_TIMEOUT, KIND_CLOSED, KIND_CRASHED)
+
+
+class SessionError(RuntimeError):
+    """A transport or protocol fault, attributed to a party and a
+    protocol step.  Replaces the bare `assert`s the session layer
+    used to have (asserts vanish under ``python -O`` and attribute
+    nothing)."""
+
+    def __init__(self, party: str, step: str, kind: str,
+                 detail: str = ""):
+        self.party = party
+        self.step = step
+        self.kind = kind
+        self.detail = detail
+        super().__init__(
+            f"[party={party} step={step} kind={kind}]"
+            + (f" {detail}" if detail else ""))
+
+    def retryable(self) -> bool:
+        return self.kind in RETRYABLE_KINDS
+
+
+class Deadline:
+    """Monotonic time budget shared by a sequence of blocking calls.
+
+    `None` seconds means unbounded (remaining() returns None); an
+    expired deadline makes the next blocking call fail immediately
+    instead of granting it a fresh per-call timeout.
+    """
+
+    __slots__ = ("_end",)
+
+    def __init__(self, seconds: Optional[float]):
+        self._end = (None if seconds is None
+                     else time.monotonic() + seconds)
+
+    def remaining(self) -> Optional[float]:
+        if self._end is None:
+            return None
+        return max(0.0, self._end - time.monotonic())
+
+    def expired(self) -> bool:
+        rem = self.remaining()
+        return rem is not None and rem <= 0.0
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        raise ValueError(f"{name} must be a number, got {raw!r}")
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        raise ValueError(f"{name} must be an integer, got {raw!r}")
+
+
+@dataclass
+class SessionConfig:
+    """Timeout/retry levers (env forms in USAGE.md's lever table).
+
+    `exchange_timeout` bounds ONE blocking send/recv; `round_deadline`
+    is the whole-round budget the collector threads through every
+    exchange of a round (a compile-heavy first round legitimately
+    takes minutes on a cold cache — the defaults leave room for that;
+    the fault tests shrink them to seconds).
+    """
+
+    connect_timeout: float = 60.0     # accept()/create_connection
+    exchange_timeout: float = 600.0   # one send/recv on a channel
+    ack_timeout: float = 60.0         # upload-ack window (marshaling
+    #                                   is cheap next to prep compile)
+    round_deadline: float = 1800.0    # budget for one whole round
+    shutdown_timeout: float = 30.0    # proc.wait at close()
+    retries: int = 2                  # extra attempts per exchange
+    backoff: float = 0.25             # base of the exponential backoff
+
+    @classmethod
+    def from_env(cls) -> "SessionConfig":
+        exchange = _env_float("MASTIC_SESSION_TIMEOUT", 600.0)
+        return cls(
+            connect_timeout=min(60.0, exchange),
+            exchange_timeout=exchange,
+            ack_timeout=min(60.0, exchange),
+            round_deadline=_env_float("MASTIC_ROUND_DEADLINE", 1800.0),
+            shutdown_timeout=min(30.0, exchange),
+            retries=_env_int("MASTIC_SESSION_RETRIES", 2),
+            backoff=_env_float("MASTIC_RETRY_BACKOFF", 0.25),
+        )
+
+    def child_env(self) -> dict:
+        """Env overrides that make spawned party processes obey this
+        config (they rebuild it with from_env)."""
+        return {
+            "MASTIC_SESSION_TIMEOUT": str(self.exchange_timeout),
+            "MASTIC_ROUND_DEADLINE": str(self.round_deadline),
+            "MASTIC_SESSION_RETRIES": str(self.retries),
+            "MASTIC_RETRY_BACKOFF": str(self.backoff),
+        }
+
+
+class Channel:
+    """Framed messages over a socket, every call deadline-bounded.
+
+    `remote` names the peer for error attribution ("leader", "helper",
+    "collector"); `injector` (drivers/faults.py) mutates outbound
+    frames when the MASTIC_FAULTS lever is armed.  Framing matches
+    `wire.frame`: 4-byte LE length prefix.
+    """
+
+    def __init__(self, sock: socket.socket, remote: str,
+                 timeout: float = 600.0, injector=None):
+        self.sock = sock
+        self.remote = remote
+        self.timeout = timeout
+        self.injector = injector
+        # Blocking sockets with per-call settimeout; disable Nagle so
+        # small protocol messages don't wait on the ack clock.
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            self._note_best_effort("setsockopt")
+
+    # -- plumbing --------------------------------------------------
+
+    def _note_best_effort(self, what: str) -> None:
+        """Best-effort socket options may fail on exotic transports
+        (AF_UNIX socketpairs in the tests); record, don't fail."""
+        self._best_effort_failure = what
+
+    def _budget(self, deadline: Optional[Deadline], step: str,
+                timeout: Optional[float] = None) -> float:
+        per_call = self.timeout if timeout is None else timeout
+        if deadline is None:
+            return per_call
+        rem = deadline.remaining()
+        if rem is None:
+            return per_call
+        if rem <= 0.0:
+            raise SessionError(self.remote, step, KIND_TIMEOUT,
+                               "session deadline exhausted")
+        return min(rem, per_call)
+
+    def _recv_exact(self, n: int, step: str,
+                    deadline: Optional[Deadline],
+                    timeout: Optional[float] = None) -> bytes:
+        buf = bytearray()
+        while len(buf) < n:
+            self.sock.settimeout(
+                self._budget(deadline, step, timeout))
+            try:
+                chunk = self.sock.recv(n - len(buf))
+            except socket.timeout:
+                raise SessionError(
+                    self.remote, step, KIND_TIMEOUT,
+                    f"no data for {self.timeout:.1f}s "
+                    f"({len(buf)}/{n} bytes of the current frame)")
+            except OSError as exc:
+                raise SessionError(self.remote, step, KIND_CLOSED,
+                                   f"socket error: {exc}")
+            if not chunk:
+                raise SessionError(
+                    self.remote, step, KIND_CLOSED,
+                    f"connection closed mid-frame "
+                    f"({len(buf)}/{n} bytes)")
+            buf += chunk
+        return bytes(buf)
+
+    # -- framed messages -------------------------------------------
+
+    def send_msg(self, payload: bytes, step: str = "send",
+                 deadline: Optional[Deadline] = None) -> None:
+        frames = [struct.pack("<I", len(payload)) + payload]
+        if self.injector is not None:
+            frames = self.injector.on_send(step, frames[0])
+        for frame in frames:
+            self.sock.settimeout(self._budget(deadline, step))
+            try:
+                self.sock.sendall(frame)
+            except socket.timeout:
+                raise SessionError(self.remote, step, KIND_TIMEOUT,
+                                   "send blocked past the deadline")
+            except OSError as exc:
+                raise SessionError(self.remote, step, KIND_CLOSED,
+                                   f"send failed: {exc}")
+
+    def recv_msg(self, step: str = "recv",
+                 deadline: Optional[Deadline] = None,
+                 timeout: Optional[float] = None
+                 ) -> Optional[bytes]:
+        """One framed message; None on clean EOF at a frame boundary
+        (the peer closed between messages — a legal shutdown).
+        `timeout` overrides the channel's per-call timeout for this
+        read (e.g. the short upload-ack window vs the long round
+        reply)."""
+        budget = self._budget(deadline, step, timeout)
+        self.sock.settimeout(budget)
+        try:
+            first = self.sock.recv(4)
+        except socket.timeout:
+            raise SessionError(self.remote, step, KIND_TIMEOUT,
+                               f"no message for {budget:.1f}s")
+        except OSError as exc:
+            raise SessionError(self.remote, step, KIND_CLOSED,
+                               f"socket error: {exc}")
+        if not first:
+            return None
+        header = first if len(first) == 4 else \
+            first + self._recv_exact(4 - len(first), step, deadline,
+                                     timeout)
+        (length,) = struct.unpack("<I", header)
+        return self._recv_exact(length, step, deadline, timeout)
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            self._note_best_effort("close")
+
+
+def connect(host: str, port: int, remote: str, timeout: float,
+            exchange_timeout: float, injector=None) -> Channel:
+    """Deadline-bounded create_connection -> Channel."""
+    try:
+        sock = socket.create_connection((host, port), timeout=timeout)
+    except socket.timeout:
+        raise SessionError(remote, "connect", KIND_TIMEOUT,
+                           f"no connection to {host}:{port} within "
+                           f"{timeout:.1f}s")
+    except OSError as exc:
+        raise SessionError(remote, "connect", KIND_CLOSED,
+                           f"connect to {host}:{port} failed: {exc}")
+    return Channel(sock, remote, exchange_timeout, injector)
+
+
+def accept(server: socket.socket, remote: str, timeout: float,
+           exchange_timeout: float, injector=None) -> Channel:
+    """Deadline-bounded server.accept() -> Channel."""
+    server.settimeout(timeout)
+    try:
+        (sock, _addr) = server.accept()
+    except socket.timeout:
+        raise SessionError(remote, "accept", KIND_TIMEOUT,
+                           f"no connection within {timeout:.1f}s")
+    except OSError as exc:
+        raise SessionError(remote, "accept", KIND_CLOSED,
+                           f"accept failed: {exc}")
+    return Channel(sock, remote, exchange_timeout, injector)
+
+
+def with_retries(fn: Callable, attempts: int, backoff: float,
+                 on_retry: Optional[Callable] = None):
+    """Run `fn()` with up to `attempts` retries on retryable
+    SessionErrors, sleeping backoff * 2^i between attempts.
+    `on_retry(err, attempt)` observes each retry (the metrics
+    counters hook in here)."""
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except SessionError as err:
+            if not err.retryable() or attempt >= attempts:
+                raise
+            if on_retry is not None:
+                on_retry(err, attempt)
+            time.sleep(backoff * (2 ** attempt))
+            attempt += 1

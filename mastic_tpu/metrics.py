@@ -40,6 +40,12 @@ class RoundMetrics:
     rejected_fallback: int = 0   # rejected by the scalar fallback path
     #                              (check attribution unknown there)
     xof_fallbacks: int = 0       # lanes recomputed via the scalar path
+    # session fault-tolerance counters (drivers/parties.py; session-
+    # cumulative so degradation is observable, not silent):
+    timeouts: int = 0            # deadline expiries attributed so far
+    retries: int = 0             # idempotent-exchange / round retries
+    quarantined: int = 0         # reports rejected at upload decode
+    respawns: int = 0            # party pairs killed and respawned
     # structural op counts, summed over both aggregators:
     node_evals: int = 0
     aes_extend_blocks: int = 0

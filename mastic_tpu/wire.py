@@ -58,6 +58,13 @@ def agg_share_size(mastic: Mastic, agg_param: MasticAggParam) -> int:
         * mastic.field.ENCODED_SIZE
 
 
+def report_size(mastic: Mastic, agg_id: int) -> int:
+    """One aggregator's view of an upload blob: nonce ‖ public share
+    ‖ that party's input share (decode_report refuses other sizes)."""
+    return (mastic.NONCE_SIZE + public_share_size(mastic)
+            + input_share_size(mastic, agg_id))
+
+
 def public_share_size(mastic: Mastic) -> int:
     """ceil(2*BITS/8) packed ctrl bits + per-level seed, payload CW
     and proof CW (SURVEY.md §2.4; encoder mastic_tpu/vidpf.py:335)."""
@@ -72,14 +79,19 @@ def public_share_size(mastic: Mastic) -> int:
 def decode_input_share(mastic: Mastic, agg_id: int,
                        encoded: bytes) -> MasticInputShare:
     if len(encoded) != input_share_size(mastic, agg_id):
-        raise ValueError("input share has incorrect length")
+        raise ValueError(
+            f"input share has incorrect length: got {len(encoded)}, "
+            f"want {input_share_size(mastic, agg_id)}")
     use_jr = mastic.flp.JOINT_RAND_LEN > 0
     (key, rest) = (encoded[:KEY_SIZE], encoded[KEY_SIZE:])
     proof_share = None
     seed = None
     if agg_id == 0:
         plen = mastic.flp.PROOF_LEN * mastic.field.ENCODED_SIZE
-        proof_share = mastic.field.decode_vec(rest[:plen])
+        try:
+            proof_share = mastic.field.decode_vec(rest[:plen])
+        except ValueError as exc:
+            raise ValueError(f"input share: proof share: {exc}")
         rest = rest[plen:]
         if use_jr:
             (seed, rest) = (rest[:SEED_SIZE], rest[SEED_SIZE:])
@@ -97,7 +109,9 @@ def decode_public_share(mastic: Mastic,
 def decode_prep_share(mastic: Mastic, agg_param: MasticAggParam,
                       encoded: bytes) -> MasticPrepShare:
     if len(encoded) != prep_share_size(mastic, agg_param):
-        raise ValueError("prep share has incorrect length")
+        raise ValueError(
+            f"prep share has incorrect length: got {len(encoded)}, "
+            f"want {prep_share_size(mastic, agg_param)}")
     (_level, _prefixes, do_weight_check) = agg_param
     (eval_proof, rest) = (encoded[:PROOF_SIZE], encoded[PROOF_SIZE:])
     verifier = None
@@ -105,7 +119,10 @@ def decode_prep_share(mastic: Mastic, agg_param: MasticAggParam,
     if do_weight_check:
         if mastic.flp.JOINT_RAND_LEN > 0:
             (jr_part, rest) = (rest[:SEED_SIZE], rest[SEED_SIZE:])
-        verifier = mastic.field.decode_vec(rest)
+        try:
+            verifier = mastic.field.decode_vec(rest)
+        except ValueError as exc:
+            raise ValueError(f"prep share: verifier: {exc}")
     return (eval_proof, verifier, jr_part)
 
 
@@ -124,8 +141,13 @@ def decode_prep_msg(mastic: Mastic, agg_param: MasticAggParam,
 def decode_agg_share(mastic: Mastic, agg_param: MasticAggParam,
                      encoded: bytes) -> list:
     if len(encoded) != agg_share_size(mastic, agg_param):
-        raise ValueError("aggregate share has incorrect length")
-    return mastic.field.decode_vec(encoded)
+        raise ValueError(
+            f"aggregate share has incorrect length: got "
+            f"{len(encoded)}, want {agg_share_size(mastic, agg_param)}")
+    try:
+        return mastic.field.decode_vec(encoded)
+    except ValueError as exc:
+        raise ValueError(f"aggregate share: {exc}")
 
 
 # -- report upload framing -------------------------------------------
@@ -141,11 +163,22 @@ def encode_report(mastic: Mastic, agg_id: int, nonce: bytes,
 
 
 def decode_report(mastic: Mastic, agg_id: int, encoded: bytes) -> tuple:
+    if len(encoded) != report_size(mastic, agg_id):
+        raise ValueError(
+            f"report for aggregator {agg_id} has incorrect length: "
+            f"got {len(encoded)}, want {report_size(mastic, agg_id)}")
     nonce = encoded[:mastic.NONCE_SIZE]
     rest = encoded[mastic.NONCE_SIZE:]
     ps_size = public_share_size(mastic)
-    public_share = mastic.vidpf.decode_public_share(rest[:ps_size])
-    input_share = decode_input_share(mastic, agg_id, rest[ps_size:])
+    try:
+        public_share = mastic.vidpf.decode_public_share(rest[:ps_size])
+    except ValueError as exc:
+        raise ValueError(f"report: public share: {exc}")
+    try:
+        input_share = decode_input_share(mastic, agg_id,
+                                         rest[ps_size:])
+    except ValueError as exc:
+        raise ValueError(f"report: {exc}")
     return (nonce, public_share, input_share)
 
 

@@ -1,0 +1,13 @@
+"""Known-bad: blocking socket reads with no deadline (RB001)."""
+
+import socket
+
+
+def serve(server: socket.socket) -> bytes:
+    (conn, _addr) = server.accept()
+    return conn.recv(4)
+
+
+def dial(port: int):
+    sock = socket.create_connection(("127.0.0.1", port))
+    return sock.makefile("rwb")

@@ -1,0 +1,14 @@
+"""Known-good: every blocking read is deadline-armed (RB001)."""
+
+import socket
+
+
+def serve(server: socket.socket) -> bytes:
+    server.settimeout(30.0)
+    (conn, _addr) = server.accept()
+    conn.settimeout(30.0)
+    return conn.recv(4)
+
+
+def dial(port: int) -> socket.socket:
+    return socket.create_connection(("127.0.0.1", port), timeout=30.0)

@@ -1,0 +1,16 @@
+"""Known-bad: except blocks that swallow the error (RB002)."""
+
+
+def swallow(path: str) -> int:
+    total = 0
+    try:
+        with open(path) as f:
+            total = len(f.read())
+    except OSError:
+        pass
+    for line in range(3):
+        try:
+            total += int(line)
+        except ValueError:
+            continue
+    return total

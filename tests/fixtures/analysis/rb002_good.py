@@ -1,0 +1,19 @@
+"""Known-good: except blocks re-raise or record the outcome (RB002)."""
+
+
+def report(path: str, counters: dict) -> int:
+    try:
+        with open(path) as f:
+            return len(f.read())
+    except OSError as exc:
+        counters["read_errors"] = counters.get("read_errors", 0) + 1
+        raise ValueError(f"unreadable {path}") from exc
+
+
+def count(path: str, counters: dict) -> int:
+    try:
+        with open(path) as f:
+            return len(f.read())
+    except OSError:
+        counters["read_errors"] = counters.get("read_errors", 0) + 1
+        return 0

@@ -30,6 +30,8 @@ CASES = {
     "PL002": ("pl002_bad.py", "pl002_good.py", "pallasck"),
     "PL003": ("pl003_bad.py", "pl003_good.py", "pallasck"),
     "PL004": ("pl004_bad.py", "pl004_good.py", "pallasck"),
+    "RB001": ("rb001_bad.py", "rb001_good.py", "robustness"),
+    "RB002": ("rb002_bad.py", "rb002_good.py", "robustness"),
 }
 
 

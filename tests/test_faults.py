@@ -1,0 +1,521 @@
+"""Fault tolerance of the process-separated aggregation sessions
+(ISSUE 3): the injectable fault matrix, deadline bounds, quarantine,
+and kill-and-resume bit-identity.
+
+Fast tier: the channel-level fault matrix over in-process socketpairs
+(every fault class, bounded structured outcomes in milliseconds), the
+cheap subprocess faults (failures before any device compile), and the
+headline kill-and-resume test.  Slow tier: the full-round subprocess
+matrix (faults at prep/resolve/agg steps — each case pays a real
+round's compile) and the joint-rand resume instance.
+
+Run the fast tier via `make faults` (wired into `make ci`).
+"""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from mastic_tpu.common import gen_rand
+from mastic_tpu.drivers import faults
+from mastic_tpu.drivers.parties import (AggregationSession,
+                                        ProcessCollector,
+                                        REASON_MALFORMED)
+from mastic_tpu.drivers.session import (Channel, Deadline,
+                                        SessionConfig, SessionError)
+from mastic_tpu.mastic import MasticCount, MasticHistogram
+
+CTX = b"fault matrix"
+
+# Cheap-fault config: everything fails fast; no full round runs under
+# this one.  shutdown_timeout stays small so close() of a party mid-
+# compile terminates instead of waiting.
+CFG_FAST = SessionConfig(connect_timeout=15.0, exchange_timeout=10.0,
+                         ack_timeout=10.0, round_deadline=30.0,
+                         shutdown_timeout=3.0, retries=1, backoff=0.1)
+# Full-round config: the per-exchange window must cover a real prep
+# compile on the CPU fabric (~1-2 min cold).
+CFG_ROUND = SessionConfig(connect_timeout=30.0,
+                          exchange_timeout=240.0, ack_timeout=60.0,
+                          round_deadline=600.0, shutdown_timeout=5.0,
+                          retries=1, backoff=0.2)
+
+
+def _count_reports(m, alphas):
+    reports = []
+    for alpha in alphas:
+        nonce = gen_rand(m.NONCE_SIZE)
+        (ps, shares) = m.shard(CTX, (alpha, 1), nonce,
+                               gen_rand(m.RAND_SIZE))
+        reports.append((nonce, ps, shares))
+    return reports
+
+
+COUNT_SPEC = {"class": "MasticCount", "args": [2]}
+COUNT_PARAM = (0, ((False,), (True,)), True)
+
+
+# -- fault-spec parser -----------------------------------------------
+
+def test_parse_faults():
+    rules = faults.parse_faults(
+        "kill:party=helper:step=round_start;"
+        "corrupt:party=leader:step=prep_share:nth=2:xor=0x80:offset=6")
+    assert [r.action for r in rules] == ["kill", "corrupt"]
+    assert rules[0].party == "helper"
+    assert rules[1].nth == 2 and rules[1].xor == 0x80 \
+        and rules[1].offset == 6
+    assert faults.parse_faults("") == []
+    assert faults.parse_faults(None) == []
+
+
+@pytest.mark.parametrize("bad", [
+    "explode:party=leader:step=x",        # unknown action
+    "drop:party=martian:step=x",          # unknown party
+    "drop:step=x",                        # missing party
+    "drop:party=leader",                  # missing step
+    "drop:party=leader:step=x:zap=1",     # unknown key
+])
+def test_parse_faults_rejects(bad):
+    with pytest.raises(ValueError):
+        faults.parse_faults(bad)
+
+
+def test_rules_fire_once_at_nth():
+    inj = faults.FaultInjector(
+        faults.parse_faults("drop:party=leader:step=s:nth=2"),
+        "leader")
+    frame = faults.frame_of(b"abc")
+    assert inj.on_send("s", frame) == [frame]      # 1st: passes
+    assert inj.on_send("s", frame) == []           # 2nd: dropped
+    assert inj.on_send("s", frame) == [frame]      # fired, inert now
+
+
+# -- channel-level fault matrix (in-process, socketpair) -------------
+
+def _pair(spec=None, party="leader", rx_timeout=0.6):
+    (a, b) = socket.socketpair()
+    inj = (faults.FaultInjector(faults.parse_faults(spec), party)
+           if spec else None)
+    tx = Channel(a, "receiver", timeout=5.0, injector=inj)
+    rx = Channel(b, party, timeout=rx_timeout)
+    return (tx, rx)
+
+
+def _send_async(tx, payload, step):
+    def run():
+        try:
+            tx.send_msg(payload, step)
+        except SessionError:
+            return  # receiver gave up first — expected for stalls
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t
+
+
+def test_clean_channel_roundtrip():
+    (tx, rx) = _pair()
+    tx.send_msg(b"hello", "s")
+    assert rx.recv_msg("s") == b"hello"
+    tx.close()
+    assert rx.recv_msg("s") is None    # clean EOF -> None
+    rx.close()
+
+
+def test_fault_drop_times_out_attributed():
+    (tx, rx) = _pair("drop:party=leader:step=prep_share")
+    tx.send_msg(b"payload", "prep_share")
+    t0 = time.monotonic()
+    with pytest.raises(SessionError) as ei:
+        rx.recv_msg("prep_share")
+    assert time.monotonic() - t0 < 3.0
+    assert ei.value.kind == "timeout"
+    assert ei.value.party == "leader"
+    assert ei.value.step == "prep_share"
+
+
+def test_fault_truncate_bounded():
+    """A frame whose header promises more bytes than arrive leaves
+    the receiver waiting — the deadline, not the peer, ends it."""
+    (tx, rx) = _pair("truncate:party=leader:step=prep_share:cut=3")
+    tx.send_msg(b"payload", "prep_share")
+    t0 = time.monotonic()
+    with pytest.raises(SessionError) as ei:
+        rx.recv_msg("prep_share")
+    assert time.monotonic() - t0 < 3.0
+    assert ei.value.kind == "timeout"
+
+
+def test_fault_corrupt_mutates_payload():
+    (tx, rx) = _pair("corrupt:party=leader:step=prep_share:xor=0x80")
+    tx.send_msg(b"payload", "prep_share")
+    got = rx.recv_msg("prep_share")
+    assert got != b"payload"
+    assert got == bytes([b"p"[0] ^ 0x80]) + b"ayload"
+
+
+def test_fault_duplicate_delivers_twice():
+    (tx, rx) = _pair("duplicate:party=leader:step=prep_share")
+    tx.send_msg(b"payload", "prep_share")
+    assert rx.recv_msg("prep_share") == b"payload"
+    assert rx.recv_msg("prep_share") == b"payload"
+
+
+def test_fault_delay_within_deadline_arrives():
+    (tx, rx) = _pair("delay:party=leader:step=prep_share:delay=0.2",
+                     rx_timeout=2.0)
+    t0 = time.monotonic()
+    _send_async(tx, b"payload", "prep_share")
+    assert rx.recv_msg("prep_share") == b"payload"
+    assert time.monotonic() - t0 >= 0.2
+
+
+@pytest.mark.parametrize("spec", [
+    "delay:party=leader:step=prep_share:delay=30",
+    "hang:party=leader:step=prep_share",
+], ids=["delay-past-deadline", "hang"])
+def test_fault_stall_times_out(spec):
+    (tx, rx) = _pair(spec)
+    t0 = time.monotonic()
+    _send_async(tx, b"payload", "prep_share")
+    with pytest.raises(SessionError) as ei:
+        rx.recv_msg("prep_share")
+    assert time.monotonic() - t0 < 3.0
+    assert ei.value.kind == "timeout"
+    assert ei.value.party == "leader"
+
+
+def test_deadline_budget_is_shared():
+    """An exhausted session deadline fails the next call immediately
+    instead of granting it a fresh per-call timeout."""
+    (_tx, rx) = _pair(rx_timeout=30.0)
+    deadline = Deadline(0.05)
+    time.sleep(0.1)
+    t0 = time.monotonic()
+    with pytest.raises(SessionError) as ei:
+        rx.recv_msg("agg_share", deadline)
+    assert time.monotonic() - t0 < 1.0
+    assert ei.value.kind == "timeout"
+    assert "deadline exhausted" in ei.value.detail
+
+
+# -- subprocess faults that fail before any device compile -----------
+
+def test_kill_at_spawn_attributed():
+    """A party that dies before the handshake fails the session
+    constructor in bounded time, attributed to the dead party."""
+    m = MasticCount(2)
+    t0 = time.monotonic()
+    with pytest.raises(SessionError) as ei:
+        ProcessCollector(m, COUNT_SPEC, CTX,
+                         gen_rand(m.VERIFY_KEY_SIZE), config=CFG_FAST,
+                         faults_spec="kill:party=helper:step=spawn")
+    assert time.monotonic() - t0 < CFG_FAST.connect_timeout + 20
+    assert ei.value.party == "helper"
+    assert ei.value.kind == "crashed"
+    assert f"rc={faults.KILL_EXIT_CODE}" in ei.value.detail
+
+
+def test_hang_at_upload_times_out_attributed():
+    """A party hanging before its upload ack fails upload() within
+    the ack window, attributed with the step name."""
+    m = MasticCount(2)
+    reports = _count_reports(m, [(False, True), (True, False)])
+    cfg = SessionConfig(connect_timeout=15.0, exchange_timeout=10.0,
+                        ack_timeout=8.0, round_deadline=30.0,
+                        shutdown_timeout=3.0, retries=0, backoff=0.1)
+    coll = ProcessCollector(
+        m, COUNT_SPEC, CTX, gen_rand(m.VERIFY_KEY_SIZE), config=cfg,
+        faults_spec="hang:party=leader:step=reports_loaded")
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(SessionError) as ei:
+            coll.upload(reports)
+        assert time.monotonic() - t0 < 30
+        assert ei.value.party == "leader"
+        assert ei.value.step == "upload_ack"
+        assert ei.value.kind == "timeout"
+        assert coll.counters["timeouts"] >= 1
+    finally:
+        coll.close()
+
+
+def test_dropped_upload_ack_is_retried():
+    """A lost ack retries the (idempotent) upload; the stale-ack
+    generation guard keeps the channel in sync, and the retry lands
+    in the counters."""
+    m = MasticCount(2)
+    reports = _count_reports(m, [(False, True), (True, False)])
+    coll = ProcessCollector(
+        m, COUNT_SPEC, CTX, gen_rand(m.VERIFY_KEY_SIZE),
+        config=CFG_FAST,
+        faults_spec="drop:party=leader:step=upload_ack")
+    try:
+        coll.upload(reports)          # succeeds on the second attempt
+        assert coll.counters["retries"] >= 1
+        assert coll.counters["timeouts"] >= 1
+        assert coll.counters["quarantined"] == 0
+    finally:
+        coll.close()
+
+
+def test_malformed_report_quarantined_not_fatal():
+    """A truncated report blob quarantines that report with a reason
+    code; the batch survives."""
+    m = MasticCount(2)
+    reports = _count_reports(m, [(False, True), (True, False),
+                                 (True, True)])
+    coll = ProcessCollector(
+        m, COUNT_SPEC, CTX, gen_rand(m.VERIFY_KEY_SIZE),
+        config=CFG_FAST,
+        faults_spec="truncate:party=collector:step=upload_report:nth=2")
+    try:
+        coll.upload(reports)
+        assert coll.quarantine == {1: REASON_MALFORMED}
+        assert coll.counters["quarantined"] == 1
+        assert list(coll.quarantine_mask()) == [False, True, False]
+    finally:
+        coll.close()
+
+
+def test_all_reports_quarantined_is_refused():
+    """Each party quarantines a DIFFERENT report (leader's copy of
+    report 0, helper's of report 1) — individually survivable, but
+    the union covers the whole batch, so the session refuses."""
+    m = MasticCount(2)
+    reports = _count_reports(m, [(False, True), (True, False)])
+    coll = ProcessCollector(
+        m, COUNT_SPEC, CTX, gen_rand(m.VERIFY_KEY_SIZE),
+        config=CFG_FAST,
+        faults_spec=("truncate:party=collector:step=upload_report:nth=1;"
+                     "truncate:party=collector:step=upload_report:nth=4"))
+    try:
+        with pytest.raises(SessionError) as ei:
+            coll.upload(reports)
+        assert ei.value.kind == "protocol"
+        assert "quarantined" in ei.value.detail
+    finally:
+        coll.close()
+
+
+def test_wholly_malformed_upload_naks():
+    """A party whose every report blob is malformed NAKs the upload
+    as a structured error instead of aggregating nothing."""
+    m = MasticCount(2)
+    reports = _count_reports(m, [(False, True)])
+    coll = ProcessCollector(
+        m, COUNT_SPEC, CTX, gen_rand(m.VERIFY_KEY_SIZE),
+        config=CFG_FAST,
+        faults_spec="truncate:party=collector:step=upload_report:nth=1")
+    try:
+        with pytest.raises(SessionError) as ei:
+            coll.upload(reports)
+        assert ei.value.kind == "malformed"
+        assert "malformed" in ei.value.detail
+    finally:
+        coll.close()
+
+
+def test_corrupt_round_command_naks_fast():
+    """A corrupted command byte is refused by the party with a
+    structured NAK — attribution arrives immediately, not after the
+    deadline."""
+    m = MasticCount(2)
+    reports = _count_reports(m, [(False, True), (True, False)])
+    coll = ProcessCollector(
+        m, COUNT_SPEC, CTX, gen_rand(m.VERIFY_KEY_SIZE),
+        config=CFG_FAST,
+        faults_spec="corrupt:party=collector:step=agg_param:offset=4:xor=16")
+    try:
+        coll.upload(reports)
+        t0 = time.monotonic()
+        with pytest.raises(SessionError) as ei:
+            coll.round(COUNT_PARAM)
+        # The NAK beats the round deadline by a wide margin.
+        assert time.monotonic() - t0 < 15
+        assert ei.value.kind == "protocol"
+        assert ei.value.step == "command"
+    finally:
+        coll.close()
+
+
+def test_snapshot_roundtrip_replays_upload():
+    m = MasticCount(2)
+    reports = _count_reports(m, [(False, True), (True, False)])
+    sess = AggregationSession(m, COUNT_SPEC, CTX,
+                              gen_rand(m.VERIFY_KEY_SIZE),
+                              config=CFG_FAST)
+    try:
+        sess.upload(reports)
+        blob = sess.to_bytes()
+    finally:
+        sess.close()
+    sess2 = AggregationSession.from_bytes(blob, config=CFG_FAST)
+    try:
+        assert sess2.coll.num_reports == 2
+        assert sess2.coll.quarantine == {}
+        assert sess2.completed == []
+    finally:
+        sess2.close()
+
+
+def test_snapshot_refuses_garbage():
+    with pytest.raises(ValueError):
+        AggregationSession.from_bytes(b"\xff" * 64)
+
+
+# -- kill-and-resume: the headline acceptance test -------------------
+
+def test_kill_and_resume_bit_identical():
+    """Killing a party mid-round, respawning, and replaying produces
+    a bit-identical aggregate, accept bitmap, and share bytes to the
+    fault-free run (MasticCount, CPU; the joint-rand instance runs in
+    the slow tier)."""
+    m = MasticCount(2)
+    vk = gen_rand(m.VERIFY_KEY_SIZE)
+    reports = _count_reports(m, [(False, True), (True, False),
+                                 (False, False)])
+
+    sess0 = AggregationSession(m, COUNT_SPEC, CTX, vk,
+                               config=CFG_ROUND)
+    try:
+        sess0.upload(reports)
+        (r0, a0, s0) = sess0.round(COUNT_PARAM)
+    finally:
+        sess0.close()
+    assert list(a0) == [True, True, True]
+    assert r0 == [2, 1]
+
+    sess1 = AggregationSession(
+        m, COUNT_SPEC, CTX, vk, config=CFG_ROUND,
+        faults_spec="kill:party=helper:step=round_start")
+    try:
+        sess1.upload(reports)
+        (r1, a1, s1) = sess1.round(COUNT_PARAM)
+    finally:
+        sess1.close()
+    assert sess1.counters["respawns"] == 1
+    assert sess1.counters["retries"] >= 1
+    assert r1 == r0
+    assert list(a1) == list(a0)
+    assert s1 == s0                      # bit-identical share bytes
+
+
+# -- full-round fault matrix (each case pays a real round) -----------
+
+@pytest.mark.slow
+@pytest.mark.parametrize("spec,expect", [
+    # Corrupted prep share: the flipped eval-proof byte rejects that
+    # report — refusal, never acceptance of a wrong aggregate.
+    ("corrupt:party=helper:step=prep_share:offset=4",
+     ("completes", [False, True], [0, 1])),
+    # Duplicated prep share: the round itself completes correctly
+    # (the stale frame desyncs the NEXT exchange, not this one).
+    ("duplicate:party=helper:step=prep_share",
+     ("completes", [True, True], [1, 1])),
+    # Truncated prep share: the leader waits for bytes that never
+    # arrive and NAKs with a timeout attributed to the helper.
+    ("truncate:party=helper:step=prep_share:cut=8",
+     ("error", "helper", ("timeout", "closed"))),
+    # Leader killed after prep: the collector sees the closed channel
+    # and attributes the crash ("closed" only if the reap race beats
+    # the grace poll).
+    ("kill:party=leader:step=prep_done",
+     ("error", "leader", ("crashed", "closed"))),
+    # Helper hangs before prep ever runs: bounded by the deadline.
+    ("hang:party=helper:step=round_start",
+     ("error", "helper", ("timeout", "crashed"))),
+])
+def test_full_round_fault_matrix(spec, expect):
+    """Every injected fault class terminates within the configured
+    deadline with a structured, party-attributed outcome — and no
+    fault ever yields a silently wrong aggregate."""
+    m = MasticCount(2)
+    reports = _count_reports(m, [(False, True), (True, False)])
+    cfg = SessionConfig(connect_timeout=30.0, exchange_timeout=150.0,
+                        ack_timeout=60.0, round_deadline=400.0,
+                        shutdown_timeout=5.0, retries=0, backoff=0.2)
+    coll = ProcessCollector(m, COUNT_SPEC, CTX,
+                            gen_rand(m.VERIFY_KEY_SIZE), config=cfg,
+                            faults_spec=spec)
+    t0 = time.monotonic()
+    try:
+        coll.upload(reports)
+        if expect[0] == "completes":
+            (result, accept, _shares) = coll.round(COUNT_PARAM)
+            assert list(accept) == expect[1]
+            assert result == expect[2]
+        else:
+            with pytest.raises(SessionError) as ei:
+                coll.round(COUNT_PARAM)
+            (_, party, kinds) = expect
+            assert ei.value.party == party
+            assert ei.value.kind in kinds
+        assert time.monotonic() - t0 < cfg.round_deadline + 120
+    finally:
+        coll.close()
+
+
+@pytest.mark.slow
+def test_kill_and_resume_joint_rand_instance():
+    """The weight-check / joint-rand instantiation (histogram)
+    survives a mid-round kill the same way: respawn + replay is
+    bit-identical."""
+    m = MasticHistogram(2, 4, 2)
+    spec = {"class": "MasticHistogram", "args": [2, 4, 2]}
+    vk = gen_rand(m.VERIFY_KEY_SIZE)
+    param = (0, ((False,), (True,)), True)
+    reports = []
+    for (alpha, weight) in [((False, False), 3), ((True, False), 1)]:
+        nonce = gen_rand(m.NONCE_SIZE)
+        (ps, shares) = m.shard(CTX, (alpha, weight), nonce,
+                               gen_rand(m.RAND_SIZE))
+        reports.append((nonce, ps, shares))
+
+    sess0 = AggregationSession(m, spec, CTX, vk, config=CFG_ROUND)
+    try:
+        sess0.upload(reports)
+        (r0, a0, s0) = sess0.round(param)
+    finally:
+        sess0.close()
+    assert list(a0) == [True, True]
+
+    sess1 = AggregationSession(
+        m, spec, CTX, vk, config=CFG_ROUND,
+        faults_spec="kill:party=leader:step=prep_done")
+    try:
+        sess1.upload(reports)
+        (r1, a1, s1) = sess1.round(param)
+    finally:
+        sess1.close()
+    assert sess1.counters["respawns"] == 1
+    assert (r1, list(a1), s1) == (r0, list(a0), s0)
+
+
+@pytest.mark.slow
+def test_snapshot_resume_replays_completed_round():
+    """A collector crash after a completed round resumes from the
+    snapshot: the round replays from stored state (fast, no party
+    round-trip) bit-identically."""
+    m = MasticCount(2)
+    vk = gen_rand(m.VERIFY_KEY_SIZE)
+    reports = _count_reports(m, [(False, True), (True, False)])
+    sess = AggregationSession(m, COUNT_SPEC, CTX, vk,
+                              config=CFG_ROUND)
+    try:
+        sess.upload(reports)
+        (r0, a0, s0) = sess.round(COUNT_PARAM)
+        blob = sess.to_bytes()
+    finally:
+        sess.close()
+
+    sess2 = AggregationSession.from_bytes(blob, config=CFG_ROUND)
+    try:
+        t0 = time.monotonic()
+        (r1, a1, s1) = sess2.round(COUNT_PARAM)
+        assert time.monotonic() - t0 < 10   # replayed, not re-run
+        assert (r1, list(a1), s1) == (r0, list(a0), s0)
+    finally:
+        sess2.close()

@@ -115,3 +115,55 @@ def test_metrics_as_dict() -> None:
     d = metrics.as_dict()
     assert d["level"] == 0 and d["reports_total"] == 3
     assert "node_evals" in d and "bytes_prep_shares" in d
+    # Session fault-tolerance counters ship in the same record.
+    for key in ("timeouts", "retries", "quarantined", "respawns"):
+        assert d[key] == 0
+
+
+def test_fault_counters_populated_by_injected_round() -> None:
+    """An injected-fault round lands its timeouts / retries /
+    quarantines in the RoundMetrics counters — degradation is
+    observable, not silent (ISSUE 3).  The respawn counter is
+    exercised by tests/test_faults.py's kill-and-resume tests."""
+    from mastic_tpu.drivers.parties import ProcessCollector
+    from mastic_tpu.drivers.session import SessionConfig
+
+    m = MasticCount(2)
+    ctx = b"fault metrics"
+    reports = []
+    for alpha in ((False, True), (True, False), (True, True)):
+        nonce = gen_rand(m.NONCE_SIZE)
+        (ps, shares) = m.shard(ctx, (alpha, 1), nonce,
+                               gen_rand(m.RAND_SIZE))
+        reports.append((nonce, ps, shares))
+    cfg = SessionConfig(connect_timeout=30.0, exchange_timeout=300.0,
+                        ack_timeout=15.0, round_deadline=600.0,
+                        shutdown_timeout=5.0, retries=2, backoff=0.1)
+    # Two faults: the leader's copy of report 1 is truncated
+    # (quarantine), and the leader's first upload ack is dropped
+    # (timeout + retry).
+    coll = ProcessCollector(
+        m, {"class": "MasticCount", "args": [2]}, ctx,
+        gen_rand(m.VERIFY_KEY_SIZE), config=cfg,
+        faults_spec=("truncate:party=collector:step=upload_report:nth=2;"
+                     "drop:party=leader:step=upload_ack"))
+    metrics_out: list = []
+    try:
+        coll.upload(reports)
+        (result, accept, _shares) = coll.round(
+            (0, ((False,), (True,)), True), metrics_out=metrics_out)
+    finally:
+        coll.close()
+
+    assert list(accept) == [True, False, True]
+    assert result == [1, 1]     # the quarantined report never counts
+    (mx,) = metrics_out
+    assert mx.reports_total == 3 and mx.accepted == 2
+    assert mx.quarantined == 1
+    assert mx.retries >= 1
+    assert mx.timeouts >= 1
+    assert mx.respawns == 0
+    assert mx.extra["quarantine"] == {"1": "malformed"}
+    assert mx.extra["process_separated"] is True
+    d = mx.as_dict()
+    assert d["quarantined"] == 1 and d["retries"] >= 1

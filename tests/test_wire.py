@@ -46,3 +46,127 @@ def test_agg_param_level_zero():
     agg_param = (0, ((False,), (True,)), True)
     encoded = mastic.encode_agg_param(agg_param)
     assert mastic.decode_agg_param(encoded) == agg_param
+
+
+# -- negative-path sweep: every decoder refuses malformed input ------
+#
+# Truncated, oversized, and bit-flipped inputs must raise ValueError /
+# EOFError with a message naming the channel — never a raw
+# struct.error or a numpy reshape traceback (ISSUE 3 satellite).
+
+def _decoders(mastic):
+    """(channel name, decoder over bytes, one honest encoding)."""
+    from mastic_tpu import wire
+    from mastic_tpu.common import gen_rand
+    from mastic_tpu.testvec_codec import (encode_agg_share,
+                                          encode_input_share,
+                                          encode_prep_share)
+
+    ctx = b"negative path"
+    bits = mastic.vidpf.BITS
+    alpha = tuple(bool(i & 1) for i in range(bits))
+    weight = 1   # valid for Count (bool) and Histogram (bucket < 4)
+    nonce = gen_rand(mastic.NONCE_SIZE)
+    (ps, shares) = mastic.shard(ctx, (alpha, weight), nonce,
+                                gen_rand(mastic.RAND_SIZE))
+    level = bits - 1
+    agg_param = (level, (alpha,), True)
+    verify_key = gen_rand(mastic.VERIFY_KEY_SIZE)
+    prep_states = []
+    prep_shares = []
+    for agg_id in range(2):
+        (state, share) = mastic.prep_init(verify_key, ctx, agg_id,
+                                          agg_param, nonce, ps,
+                                          shares[agg_id])
+        prep_states.append(state)
+        prep_shares.append(share)
+    prep_msg = mastic.prep_shares_to_prep(ctx, agg_param, prep_shares)
+    out = mastic.prep_next(ctx, prep_states[0], prep_msg)
+    agg = mastic.agg_update(agg_param, mastic.agg_init(agg_param), out)
+
+    return [
+        ("report",
+         lambda b: wire.decode_report(mastic, 0, b),
+         wire.encode_report(mastic, 0, nonce, ps, shares[0])),
+        ("input share",
+         lambda b: wire.decode_input_share(mastic, 1, b),
+         encode_input_share(mastic, shares[1])),
+        ("prep share",
+         lambda b: wire.decode_prep_share(mastic, agg_param, b),
+         encode_prep_share(mastic, prep_shares[0])),
+        ("prep message",
+         lambda b: wire.decode_prep_msg(mastic, agg_param, b),
+         prep_msg or b""),
+        ("aggregate share",
+         lambda b: wire.decode_agg_share(mastic, agg_param, b),
+         encode_agg_share(mastic, agg)),
+        ("public share",
+         lambda b: mastic.vidpf.decode_public_share(b),
+         mastic.vidpf.encode_public_share(ps)),
+    ]
+
+
+@pytest.mark.parametrize("mastic", [MasticCount(2),
+                                    MasticHistogram(2, 4, 2)],
+                         ids=["Count", "Histogram-jointrand"])
+def test_decoders_reject_malformed(mastic):
+    for (name, decode, honest) in _decoders(mastic):
+        decode(honest)  # sanity: the honest encoding decodes
+        # Truncated and oversized inputs are always refused.
+        for bad in (honest[:-1], honest + b"\x00", b""):
+            if bad == honest:
+                continue  # Count's prep message is legally empty
+            with pytest.raises((ValueError, EOFError)):
+                decode(bad)
+        # Bit-flips either decode (the flip lands in free bytes) or
+        # refuse with ValueError/EOFError — never a struct.error or
+        # numpy traceback.  Sweep a byte in each region of the blob.
+        for pos in {0, len(honest) // 3, len(honest) // 2,
+                    2 * len(honest) // 3, len(honest) - 1}:
+            if pos < 0 or pos >= len(honest):
+                continue  # the empty prep message has no bytes to flip
+            flipped = (honest[:pos]
+                       + bytes([honest[pos] ^ 0x80])
+                       + honest[pos + 1:])
+            try:
+                decode(flipped)
+            except (ValueError, EOFError):
+                pass  # refusal is fine; any other exception fails
+
+
+def test_decoders_name_the_channel():
+    from mastic_tpu import wire
+
+    mastic = MasticCount(2)
+    agg_param = (1, ((False, True),), True)
+    cases = [
+        ("report", lambda: wire.decode_report(mastic, 0, b"\x00" * 7)),
+        ("input share",
+         lambda: wire.decode_input_share(mastic, 0, b"\x00" * 7)),
+        ("prep share",
+         lambda: wire.decode_prep_share(mastic, agg_param,
+                                        b"\x00" * 7)),
+        ("prep message",
+         lambda: wire.decode_prep_msg(mastic, agg_param, b"\x00" * 7)),
+        ("aggregate share",
+         lambda: wire.decode_agg_share(mastic, agg_param,
+                                       b"\x00" * 7)),
+    ]
+    for (name, call) in cases:
+        with pytest.raises(ValueError, match=name.split()[0]):
+            call()
+    # Out-of-range field elements are named too, not raw tracebacks.
+    size = wire.agg_share_size(mastic, agg_param)
+    with pytest.raises(ValueError, match="aggregate share"):
+        wire.decode_agg_share(mastic, agg_param, b"\xff" * size)
+
+
+def test_unframe_rejects_truncation():
+    from mastic_tpu import wire
+
+    framed = wire.frame(b"payload")
+    assert wire.unframe(framed) == (b"payload", b"")
+    with pytest.raises(ValueError, match="frame"):
+        wire.unframe(framed[:3])        # inside the header
+    with pytest.raises(ValueError, match="frame"):
+        wire.unframe(framed[:-2])       # inside the payload

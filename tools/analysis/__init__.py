@@ -9,6 +9,7 @@ contract it checks:
   dtypes      DT001-DT003   mastic_tpu/ops/ (field/AES/Keccak kernels)
   secretflow  SF001-SF002   vidpf.py, mastic.py, aes.py, xof.py
   pallasck    PL001-PL004   any file calling pallas_call
+  robustness  RB001-RB002   mastic_tpu/drivers/ (session layer)
 
 plus the suppression meta-rules AL001 (mastic-allow without a written
 justification) and AL002 (mastic-allow that silences nothing), and
@@ -25,10 +26,10 @@ See USAGE.md ("Static analysis") for the rule table and workflow.
 import json
 import pathlib
 
-from . import dtypes, pallasck, secretflow, tracesafe
+from . import dtypes, pallasck, robustness, secretflow, tracesafe
 from .core import REPO, Finding, load_file
 
-PASSES = (tracesafe, dtypes, secretflow, pallasck)
+PASSES = (tracesafe, dtypes, secretflow, pallasck, robustness)
 
 DEFAULT_ROOTS = ("mastic_tpu", "tools", "bench.py")
 

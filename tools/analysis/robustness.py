@@ -1,0 +1,161 @@
+"""Pass 5 — robustness of the session/driver layer.
+
+Scope: mastic_tpu/drivers/ — the layer that owns sockets, subprocess
+lifecycles, and fault handling (ISSUE 3).  Two failure modes this
+pass keeps out of the tree:
+
+  RB001  a blocking socket read with no deadline.  Flags calls to
+         `.accept()` / `.recv()` / `.makefile()` in a scope that
+         never arms a timeout (`settimeout` on the same root object,
+         or a `timeout=` keyword on the call itself), plus
+         `create_connection` without a `timeout=`.  `makefile()` is
+         flagged unconditionally: the file wrapper has no usable
+         deadline story (a timeout mid-read leaves its buffer
+         inconsistent), and the drivers' Channel replaces it.
+
+  RB002  an `except` block that swallows the error: a handler whose
+         body is only `pass` / `continue` / `break` / `...` —
+         no re-raise, no structured report (a call, return or
+         assignment that records the outcome).  Silent except blocks
+         are how a faulted session degrades invisibly instead of
+         landing in a counter.
+
+Intentional exceptions are suppressed inline with a justified
+`# mastic-allow: RB00x — reason`, same as every other pass.
+"""
+
+import ast
+
+from .core import Finding, root_name
+
+PASS_NAME = "robustness"
+
+RULES = {
+    "RB001": "blocking socket read without a deadline",
+    "RB002": "except block swallows the error without re-raise or "
+             "structured report",
+}
+
+SCOPE_PREFIX = "mastic_tpu/drivers/"
+
+_BLOCKING_READS = {"accept", "recv", "recv_into", "makefile"}
+_CONNECT_FNS = {"create_connection"}
+
+
+def in_scope(rel: str) -> bool:
+    return rel.startswith(SCOPE_PREFIX)
+
+
+def _scopes(tree: ast.Module):
+    """Every function scope plus the module body (socket code at
+    module level is in scope too)."""
+    yield tree
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _scope_statements(scope):
+    """Nodes of this scope only (nested function bodies are their own
+    scopes; their timeouts don't arm this one's reads)."""
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _has_timeout_kw(call: ast.Call) -> bool:
+    return any(kw.arg in ("timeout", "deadline")
+               for kw in call.keywords)
+
+
+def _check_rb001(info, findings) -> None:
+    for scope in _scopes(info.tree):
+        nodes = list(_scope_statements(scope))
+        armed = set()
+        for node in nodes:
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "settimeout":
+                armed.add(root_name(node.func.value))
+        for node in nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            if isinstance(node.func, ast.Attribute):
+                attr = node.func.attr
+                if attr not in _BLOCKING_READS:
+                    continue
+                if attr == "accept" and (node.args or node.keywords):
+                    # socket.accept() takes no arguments; a call with
+                    # some is a different accept (e.g. the session
+                    # layer's deadline-bounded wrapper).
+                    continue
+                if attr == "makefile":
+                    findings.append(Finding(
+                        "RB001", info.rel, node.lineno,
+                        "socket.makefile() read path has no usable "
+                        "deadline — use the drivers' Channel"))
+                    continue
+                root = root_name(node.func.value)
+                if root in armed or _has_timeout_kw(node):
+                    continue
+                findings.append(Finding(
+                    "RB001", info.rel, node.lineno,
+                    f"blocking .{attr}() with no deadline: no "
+                    f"settimeout on '{root or '<expr>'}' in this "
+                    f"scope and no timeout= on the call"))
+            elif isinstance(node.func, ast.Name) \
+                    and node.func.id in _CONNECT_FNS:
+                if not _has_timeout_kw(node):
+                    findings.append(Finding(
+                        "RB001", info.rel, node.lineno,
+                        f"{node.func.id}() without timeout= blocks "
+                        f"until the kernel gives up"))
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _CONNECT_FNS:
+                if not _has_timeout_kw(node):
+                    findings.append(Finding(
+                        "RB001", info.rel, node.lineno,
+                        f"{node.func.attr}() without timeout= blocks "
+                        f"until the kernel gives up"))
+
+
+def _swallows(handler: ast.ExceptHandler) -> bool:
+    """True when every statement of the handler body is inert: no
+    raise, no call, no return/assign that could record the outcome."""
+    for stmt in handler.body:
+        if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+            continue
+        if isinstance(stmt, ast.Expr) \
+                and isinstance(stmt.value, ast.Constant):
+            continue  # docstring / ellipsis
+        return False
+    return True
+
+
+def _check_rb002(info, findings) -> None:
+    for node in ast.walk(info.tree):
+        if isinstance(node, ast.ExceptHandler) and _swallows(node):
+            what = ("bare except" if node.type is None
+                    else ast.unparse(node.type)[:40])
+            findings.append(Finding(
+                "RB002", info.rel, node.lineno,
+                f"except ({what}) swallows the error — re-raise, or "
+                f"record it (counter/log/return)"))
+
+
+def check(info) -> list:
+    findings: list = []
+    _check_rb001(info, findings)
+    _check_rb002(info, findings)
+    seen = set()
+    out = []
+    for f in findings:
+        if f.key() in seen:
+            continue
+        seen.add(f.key())
+        out.append(f)
+    return out
