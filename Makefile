@@ -14,19 +14,26 @@
 #                session layer (deadlines, injection, quarantine,
 #                respawn; USAGE.md "Fault model & injection") —
 #                fast tier only; the full-round matrix is slow-tier
+#   make pipeline  pipelined chunk-streaming executor suite
+#                (drivers/pipeline.py: serial bit-identity, overlap
+#                timeline, AOT bucket compile, budget fallback) —
+#                fast tier only
 #   make test    full suite (adds the slow differential/adversarial/
 #                driver tiers)
 #   make bench   single-chip benchmark (prints one JSON line)
 
 PY ?= python
 
-.PHONY: ci lint analyze faults typecheck test-fast test test-slow \
-	test-slow-1 test-slow-2 bench
+.PHONY: ci lint analyze faults pipeline typecheck test-fast test \
+	test-slow test-slow-1 test-slow-2 bench
 
-ci: lint analyze faults typecheck test-fast
+ci: lint analyze faults pipeline typecheck test-fast
 
 faults:
 	$(PY) -m pytest tests/test_faults.py -q -m "not slow"
+
+pipeline:
+	$(PY) -m pytest tests/test_pipeline.py -q -m "not slow"
 
 lint:
 	$(PY) tools/lint.py
@@ -43,11 +50,13 @@ typecheck:
 		     "scalar layer) - skipping"; \
 	fi
 
-# test_faults' fast tier already ran as its own `faults` gate right
-# after analyze — skip it here so `make ci` doesn't pay for it twice.
+# test_faults' / test_pipeline's fast tiers already ran as their own
+# gates right after analyze — skip them here so `make ci` doesn't pay
+# for them twice.
 test-fast:
 	$(PY) -m pytest tests/ -q -m "not slow" \
-		--ignore=tests/test_faults.py
+		--ignore=tests/test_faults.py \
+		--ignore=tests/test_pipeline.py
 
 test-slow:
 	$(PY) -m pytest tests/ -q -m "slow"

@@ -500,6 +500,84 @@ def bench_incremental_round(bm, num_reports: int, frontier: int,
     return (per_round, evals / per_round, compile_s)
 
 
+def bench_chunked_round(args) -> dict:
+    """The chunked PRODUCTION round on the pipelined executor
+    (drivers/pipeline.py, `MASTIC_PIPELINE`): a small planted
+    heavy-hitters run streamed through fixed-size chunks, measuring
+    the per-phase timeline (upload / dispatch / compute-wait /
+    download / host / compile) and the overlap efficiency — the
+    numbers ISSUE 4 moves; the headline eval_step bench cannot see
+    them because it never leaves the device."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from mastic_tpu import MasticCount
+    from mastic_tpu.backend.mastic_jax import BatchedMastic
+    from mastic_tpu.common import gen_rand
+    from mastic_tpu.drivers.chunked import HostReportStore
+    from mastic_tpu.drivers.heavy_hitters import HeavyHittersRun
+
+    (bits, R, C) = (32, args.chunked_reports, args.chunked_reports // 4)
+    m = MasticCount(bits)
+    bm = BatchedMastic(m)
+    rng = np.random.default_rng(5)
+    # Three planted paths, no uniform tail: the frontier stays <= 6
+    # wide for the whole depth, so the run is round-loop-bound (the
+    # thing being measured), not node-eval-bound.
+    paths = rng.integers(0, 2, (3, bits)).astype(bool)
+    alphas = paths[rng.integers(0, 3, R)]
+    beta = np.stack([bm.spec.int_to_limbs(el.int())
+                     for el in [m.field(1)] + m.flp.encode(1)])
+    betas = np.broadcast_to(beta, (R,) + beta.shape)
+    shard_fn = jax.jit(
+        lambda a, b, n, r: bm.shard_device(b"bench", a, b, n, r))
+    (batch, ok) = shard_fn(
+        jnp.asarray(alphas), jnp.asarray(betas),
+        jnp.asarray(rng.integers(0, 256, (R, 16), dtype=np.uint8)),
+        jnp.asarray(rng.integers(0, 256, (R, m.RAND_SIZE),
+                                 dtype=np.uint8)))
+    assert bool(np.all(np.asarray(ok)))
+    store = HostReportStore.from_batch(batch, C)
+    run = HeavyHittersRun(m, b"bench", {"default": R // 6}, None,
+                          verify_key=gen_rand(m.VERIFY_KEY_SIZE),
+                          store=store)
+    t0 = time.perf_counter()
+    while run.step():
+        pass
+    wall = time.perf_counter() - t0
+
+    pipes = [mx.extra["pipeline"] for mx in run.metrics]
+    effs = sorted(p["overlap_efficiency"] for p in pipes)
+    rounds = sorted(p["round_wall_ms"] for p in pipes)
+    phases: dict = {}
+    for mx in run.metrics:
+        for rec in mx.extra["chunks"]:
+            for (k, v) in rec["phases"].items():
+                phases[k] = phases.get(k, 0.0) + v
+    evals = sum(mx.node_evals for mx in run.metrics)
+    return {
+        "instance": f"MasticCount({bits})",
+        "reports": R, "chunk_size": C, "levels": len(run.metrics),
+        "pipeline": pipes[-1]["mode"],
+        "fallbacks": sorted({p["fallback"] for p in pipes
+                             if p["fallback"]}),
+        "wall_seconds": round(wall, 2),
+        "round_ms_p50": round(rounds[len(rounds) // 2], 2),
+        "node_evals_per_sec": round(evals / wall, 1),
+        "overlap_efficiency_p50": effs[len(effs) // 2],
+        "overlap_efficiency_max": effs[-1],
+        "phase_ms": {k: round(v, 1) for (k, v) in sorted(
+            phases.items())},
+        "compile_inline_ms_total": round(
+            sum(p["compile_inline_ms"] for p in pipes), 1),
+        "aot_inline_compiles":
+            run.runner.programs.stats["inline_compiles"],
+        "aot_warm_compiles":
+            run.runner.programs.stats["warm_compiles"],
+    }
+
+
 def run_configs(args) -> dict:
     """The BASELINE.json per-config benches; each fails open into the
     shared record."""
@@ -538,6 +616,14 @@ def run_configs(args) -> dict:
     }
     stamp("config-histogram-done",
           rps=f"{2048 / per_round:.0f}")
+
+    # 2b. Pipelined chunked production round: phase timeline +
+    # overlap efficiency (drivers/pipeline.py).
+    stamp("config-chunked-round",
+          pipeline=os.environ.get("MASTIC_PIPELINE", "1"))
+    configs["chunked_round"] = bench_chunked_round(args)
+    stamp("config-chunked-round-done",
+          eff=configs["chunked_round"]["overlap_efficiency_p50"])
 
     # 3. SumVec(1024) Field128 @ BITS=128: huge-payload convert.
     stamp("config-sumvec-f128")
@@ -586,6 +672,21 @@ def main():
                         "the fused-VMEM Pallas megakernel "
                         "(MASTIC_LEVEL_PALLAS) — the HBM-roofline "
                         "lever, PERF.md §3")
+    parser.add_argument("--pipeline", choices=("on", "off"),
+                        default=None,
+                        help="set the MASTIC_PIPELINE lever for the "
+                        "chunked-round config (drivers/pipeline.py: "
+                        "double-buffered chunk streaming + "
+                        "ahead-of-time bucket compile; default on)")
+    parser.add_argument("--chunked-round-only", action="store_true",
+                        help="run ONLY the chunked pipelined round "
+                        "bench (per-phase timeline + "
+                        "overlap_efficiency) — the MASTIC_PIPELINE "
+                        "on/off comparison cell of "
+                        "tools/chip_session.sh")
+    parser.add_argument("--chunked-reports", type=int, default=1024,
+                        help="report count for the chunked-round "
+                        "config (4 chunks)")
     parser.add_argument("--watchdog", type=float, default=1500.0)
     parser.add_argument("--attach-timeout", type=float, default=60.0)
     parser.add_argument("--attach-retries", type=int, default=3)
@@ -609,6 +710,9 @@ def main():
         os.environ["MASTIC_AES_PALLAS"] = "1"
     if args.level_pallas:
         os.environ["MASTIC_LEVEL_PALLAS"] = "1"
+    if args.pipeline is not None:
+        os.environ["MASTIC_PIPELINE"] = \
+            "1" if args.pipeline == "on" else "0"
 
     # Pre-seed the fail-open record from the last verified run BEFORE
     # anything that can hang, so every exit path has a nonzero number
@@ -626,11 +730,6 @@ def main():
     requested = os.environ.get("JAX_PLATFORMS", "").strip()
     if requested and "axon" not in requested.split(","):
         jax.config.update("jax_platforms", requested)
-    # Persistent compile cache, keyed by host so a cache built on a
-    # different machine type is never reused (XLA rejects mismatched
-    # machine types with noisy warnings and, historically, SIGILL).
-    cache = f"/tmp/mastic_tpu_jax_cache_{socket.gethostname()}"
-    jax.config.update("jax_compilation_cache_dir", cache)
 
     stamp("scalar-baseline")
     base = scalar_rate(bits=args.bits)
@@ -664,6 +763,41 @@ def main():
     # Stamped into every emit from here on, so a CPU-sim rate can
     # never be mistaken for a chip rate in a round artifact.
     PARTIAL["platform"] = devices[0].platform
+    # Persistent compile cache, keyed by host so a cache built on a
+    # different machine type is never reused (XLA rejects mismatched
+    # machine types with noisy warnings and, historically, SIGILL).
+    # Platform-gated since r9: on the CPU fabric, RELOADING cached
+    # executables segfaults or loads silently wrong programs
+    # (reproduced at the pre-pipeline HEAD; PERF.md §7), so only chip
+    # runs get the cache unless MASTIC_COMPILE_CACHE=1 forces it
+    # (=0 forces it off).
+    cache_lever = os.environ.get("MASTIC_COMPILE_CACHE", "")
+    if cache_lever == "1" or (cache_lever != "0" and on_chip):
+        cache = f"/tmp/mastic_tpu_jax_cache_{socket.gethostname()}"
+        jax.config.update("jax_compilation_cache_dir", cache)
+
+    if args.chunked_round_only:
+        # The MASTIC_PIPELINE on/off comparison cell: one JSON line
+        # with the chunked production round's phase timeline and
+        # overlap efficiency.  Never touches BENCH_LAST_GOOD (it is a
+        # different metric than the headline).
+        PARTIAL["metric"] = "chunked_round_node_evals_per_sec"
+        PARTIAL["pipeline"] = \
+            os.environ.get("MASTIC_PIPELINE", "1") != "0"
+        for key in ("cached", "cached_provenance", "configs",
+                    "configs_provenance", "vs_baseline"):
+            PARTIAL.pop(key, None)
+        stamp("chunked-round", reports=args.chunked_reports,
+              pipeline=PARTIAL["pipeline"])
+        rec = bench_chunked_round(args)
+        PARTIAL["value"] = rec["node_evals_per_sec"]
+        PARTIAL["overlap_efficiency"] = rec["overlap_efficiency_p50"]
+        PARTIAL["configs"] = {"chunked_round": rec}
+        timer.cancel()
+        stamp("done", rate=f"{rec['node_evals_per_sec']:.0f}",
+              eff=rec["overlap_efficiency_p50"])
+        emit()
+        return
 
     from mastic_tpu import MasticCount
     from mastic_tpu.backend.mastic_jax import BatchedMastic

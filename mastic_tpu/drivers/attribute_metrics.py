@@ -67,12 +67,23 @@ def _run_round_chunked(bm: BatchedMastic, verify_key: bytes,
                        chunk_size: int,
                        metrics_out: Optional[list]) -> list:
     """One from-root aggregation round streamed chunk by chunk
-    (heavy_hitters.run_round semantics, accumulated aggregates)."""
+    (heavy_hitters.run_round semantics, accumulated aggregates), on
+    the pipelined executor: chunk i+1's scalar reports marshal (the
+    host-heavy step) and its round dispatches while chunk i's device
+    round computes and downloads — one blocking sync per chunk, the
+    per-chunk phase timeline in `RoundMetrics.extra["pipeline"]`.
+    Bit-identical to the serial loop (same programs, same fold
+    order); `MASTIC_PIPELINE=0` restores strict serial execution."""
+    import time
+
+    import jax
     import numpy as np
 
     from ..common import vec_add
     from ..backend.schedule import LevelSchedule
     from .heavy_hitters import _round_fn, _vk_array, finalize_round
+    from .pipeline import (overlap_efficiency, paused_gc,
+                           pipeline_enabled, run_chunks)
 
     (level, prefixes, do_weight_check) = agg_param
     num = len(reports)
@@ -83,13 +94,31 @@ def _run_round_chunked(bm: BatchedMastic, verify_key: bytes,
     eval_ok = np.zeros(num, bool)
     wc_ok: Optional[np.ndarray] = None
     jr_ok: Optional[np.ndarray] = None
+    bounds = [(lo, min(lo + chunk_size, num))
+              for lo in range(0, num, chunk_size)]
+    vk_arr = _vk_array(verify_key)
+    fn = _round_fn(bm, ctx, agg_param)
 
-    for lo in range(0, num, chunk_size):
-        chunk = reports[lo:lo + chunk_size]
-        hi = lo + len(chunk)
-        batch = bm.marshal_reports(chunk)
-        (agg0, agg1, accept, ok, checks) = _round_fn(
-            bm, ctx, agg_param)(_vk_array(verify_key), batch)
+    def stage(i: int):
+        (lo, hi) = bounds[i]
+        t0 = time.perf_counter()
+        batch = bm.marshal_reports(reports[lo:hi])
+        t_up = time.perf_counter()
+        out = fn(vk_arr, batch)
+        t_d = time.perf_counter()
+        phases = {
+            "upload_ms": round((t_up - t0) * 1e3, 3),
+            "dispatch_ms": round((t_d - t_up) * 1e3, 3),
+        }
+        return (out, phases)
+
+    def collect(i: int, handle) -> dict:
+        nonlocal wc_ok, jr_ok
+        (agg0, agg1, accept, ok, checks) = handle
+        (lo, hi) = bounds[i]
+        t0 = time.perf_counter()
+        jax.block_until_ready((agg0, agg1, accept, ok, checks))
+        t_wait = time.perf_counter()
         ok_all[lo:hi] = np.asarray(ok)
         accept_all[lo:hi] = np.asarray(accept)
         eval_ok[lo:hi] = np.asarray(checks["eval_proof"])
@@ -101,9 +130,25 @@ def _run_round_chunked(bm: BatchedMastic, verify_key: bytes,
             if jr_ok is None:
                 jr_ok = np.zeros(num, bool)
             jr_ok[lo:hi] = np.asarray(checks["joint_rand"])
+        t_down = time.perf_counter()
         for (a, arr) in ((0, agg0), (1, agg1)):
             agg_shares[a] = vec_add(agg_shares[a],
                                     bm.agg_share_to_host(arr))
+        t_host = time.perf_counter()
+        return {
+            "compute_wait_ms": round((t_wait - t0) * 1e3, 3),
+            "download_ms": round((t_down - t_wait) * 1e3, 3),
+            "host_ms": round((t_host - t_down) * 1e3, 3),
+        }
+
+    pipelined = pipeline_enabled() and len(bounds) > 1
+    with paused_gc():
+        # GC paused for the chunk loop's traces (pipeline.paused_gc).
+        (timeline, wall_ms) = run_chunks(len(bounds), stage, collect,
+                                         pipelined=pipelined)
+    for rec in timeline:
+        (lo, hi) = bounds[rec["chunk"]]
+        rec["reports"] = hi - lo
 
     sched = LevelSchedule(prefixes, level, bm.m.vidpf.BITS)
     checks = {"eval_proof": eval_ok}
@@ -115,4 +160,14 @@ def _run_round_chunked(bm: BatchedMastic, verify_key: bytes,
         bm, verify_key, ctx, agg_param, reports, ok_all, accept_all,
         checks, agg_shares, padded_width=sched.total_nodes,
         nodes_evaluated=sched.total_nodes, metrics_out=metrics_out,
-        extra={"chunk_size": chunk_size})
+        extra={"chunk_size": chunk_size,
+               "chunks": timeline,
+               "pipeline": {
+                   "mode": "pipelined" if pipelined else "serial",
+                   "fallback": (None if pipelined else
+                                ("single-chunk" if len(bounds) < 2
+                                 else "lever-off")),
+                   "round_wall_ms": round(wall_ms, 2),
+                   "overlap_efficiency": overlap_efficiency(
+                       timeline, wall_ms),
+               }})

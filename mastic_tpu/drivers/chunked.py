@@ -41,6 +41,10 @@ from ..backend.mastic_jax import BatchedMastic, ReportBatch
 # headroom); <= 0 disables a budget.
 DEVICE_BUDGET_DEFAULT = 12 << 30
 
+# Double buffering: the pipelined executor (drivers/pipeline.py)
+# keeps exactly one extra chunk's resident state in flight.
+PIPELINE_CHUNKS_IN_FLIGHT = 2
+
 
 def _device_budget() -> int:
     return int(os.environ.get("MASTIC_DEVICE_BUDGET_BYTES",
@@ -153,6 +157,21 @@ def memory_envelope(bm: BatchedMastic, chunk_size: int, width: int,
         "per_report_bytes": per,
         "device_bytes_per_chunk": chunk_size * per_chunk,
         "device_peak_bytes_per_chunk": chunk_size * per_peak,
+        # Pipelined streaming keeps TWO chunks' resident state in
+        # flight (chunk i+1 uploads while chunk i computes/downloads;
+        # drivers/pipeline.py) — the binder staging is paid once, only
+        # the chunk in its compute phase holds it.  The executor
+        # degrades to serial when this doubled footprint would exceed
+        # the budget (round_peak_bytes below, at the ACTUAL buckets).
+        "pipeline_chunks_in_flight": PIPELINE_CHUNKS_IN_FLIGHT,
+        "device_bytes_per_chunk_pipelined":
+            PIPELINE_CHUNKS_IN_FLIGHT * chunk_size * per_chunk,
+        "device_peak_bytes_per_chunk_pipelined":
+            PIPELINE_CHUNKS_IN_FLIGHT * chunk_size * per_chunk
+            + chunk_size * per["binder_peak"],
+        "max_pipelined_chunk_size_at_width": (
+            device_budget // (PIPELINE_CHUNKS_IN_FLIGHT * per_chunk)
+            if device_budget > 0 else 0),
         "host_bytes_total": host_total,
         "device_budget_bytes": device_budget,
         "host_budget_bytes": host_budget,
@@ -211,6 +230,23 @@ def check_envelope(bm: BatchedMastic, chunk_size: int, width: int,
     return env
 
 
+def round_peak_bytes(bm: BatchedMastic, onehot_cap: int,
+                     payload_cap: int, chunk_rows: int,
+                     resident_bytes: int, n_device_shards: int = 1,
+                     chunks_in_flight: int = 1) -> int:
+    """Per-chip peak of one round at the ACTUAL binder buckets:
+    `chunks_in_flight` copies of the resident chunk state (the
+    pipelined executor keeps two) plus ONE chunk's binder staging
+    (only the chunk in its compute phase holds the staging buffers).
+    The single cost model behind check_round_peak (serial, raising)
+    and the pipeline executor's degrade-to-serial decision
+    (non-raising, drivers/chunked.ChunkedIncrementalRunner)."""
+    staging = _binder_staging_bytes(bm, onehot_cap,
+                                    payload_cap) * chunk_rows
+    return -(-(chunks_in_flight * resident_bytes + staging)
+             // n_device_shards)
+
+
 def check_round_peak(bm: BatchedMastic, onehot_cap: int,
                      payload_cap: int, chunk_rows: int,
                      resident_bytes: int, level: int,
@@ -235,7 +271,8 @@ def check_round_peak(bm: BatchedMastic, onehot_cap: int,
         return
     per_row = _binder_staging_bytes(bm, onehot_cap, payload_cap)
     staging = per_row * chunk_rows
-    peak = -(-(resident_bytes + staging) // n_device_shards)
+    peak = round_peak_bytes(bm, onehot_cap, payload_cap, chunk_rows,
+                            resident_bytes, n_device_shards)
     if peak > budget:
         # Largest TOTAL chunk size (across all its device shards)
         # whose peak fits: (resident_scaled + per_row*rows)/shards
@@ -404,9 +441,7 @@ class ChunkedIncrementalRunner(RoundPrograms):
                        self.num_reports, self.n_device_shards)
         self.mesh = None  # set via parallel.mesh.shard_incremental_runner
         self.engine = IncrementalMastic(bm, self.width)
-        self._eval_fn = None
-        self._agg_fn = None
-        self._wc_fns: dict = {}
+        self._init_programs()
         self._rk_fn = jax.jit(lambda n: bm.vidpf.roundkeys(ctx, n))
         self.chunks = [self._init_chunk(i)
                        for i in range(store.num_chunks)]
@@ -451,30 +486,74 @@ class ChunkedIncrementalRunner(RoundPrograms):
                 )
         self.width = width
         self.engine = IncrementalMastic(self.bm, width)
+        # AOT programs key on their shapes (the grown width maps to
+        # fresh keys); only the jitted closures capture the engine.
         self._eval_fn = None
-        self._agg_fn = None
+        self._combine_fn = None
 
     # -- one round over every chunk --------------------------------
 
+    def _pipeline_mode(self, plan) -> tuple:
+        """(mode, fallback_reason): whether this round runs the
+        double-buffered executor or degrades to serial — and why, so
+        the fallback is named in metrics, never silent."""
+        from .pipeline import pipeline_enabled
+
+        if not pipeline_enabled():
+            return ("serial", "lever-off")
+        if self.store.num_chunks < 2:
+            return ("serial", "single-chunk")
+        if self.mesh is not None:
+            # Mesh rounds stay on the jitted/GSPMD path; overlapping
+            # sharded uploads is future work.
+            return ("serial", "mesh")
+        budget = _device_budget()
+        if budget > 0:
+            peak = round_peak_bytes(
+                self.bm, len(plan.onehot_idx),
+                len(plan.payload_parent), self.store.chunk_size,
+                self.memory_accounting()["device_bytes_per_chunk"],
+                self.n_device_shards,
+                chunks_in_flight=PIPELINE_CHUNKS_IN_FLIGHT)
+            if peak > budget:
+                return ("serial", "device-budget")
+        return ("pipelined", None)
+
     def round(self, agg_param,
               metrics_out: Optional[list] = None) -> list:
+        """One round over every chunk on the pipelined executor
+        (drivers/pipeline.py): chunk i+1's batch and carries upload
+        and its whole eval -> weight-check -> mask-combine ->
+        aggregate chain dispatches while chunk i computes and its
+        result carries download — one blocking host sync per chunk,
+        issued after the next chunk's work is in flight.  The
+        accept/ok/weight-check masks combine ON DEVICE (exactly the
+        serial boolean algebra, so aggregates are bit-identical),
+        and the per-chunk phase timeline lands in
+        `RoundMetrics.extra`.  Degrades to serial (same stage/collect
+        bodies, no overlap) when the doubled in-flight footprint
+        exceeds the device budget — the fallback is named in
+        metrics."""
         from ..backend.incremental import round_inputs
         from .heavy_hitters import _vk_array, splice_rejected
+        from .pipeline import overlap_efficiency, run_chunks
 
         (level, prefixes, do_weight_check) = agg_param
         plan = self._plan(prefixes, level)
+        shards = (self.mesh.shape["reports"] if self.mesh is not None
+                  else self.n_device_shards)
         check_round_peak(
             self.bm,
             len(plan.onehot_idx), len(plan.payload_parent),
             self.store.chunk_size,
             self.memory_accounting()["device_bytes_per_chunk"],
-            level,
-            (self.mesh.shape["reports"] if self.mesh is not None
-             else self.n_device_shards))
+            level, shards)
+        (mode, fb_reason) = self._pipeline_mode(plan)
         rnd = round_inputs(plan)
         vk_arr = _vk_array(self.verify_key)
-        (eval_fn, agg_fn) = self._fns()
         rows = len(prefixes) * (1 + self.bm.m.flp.OUTPUT_LEN)
+        chunk_size = self.store.chunk_size
+        ones = jnp.ones(chunk_size, bool)
 
         agg_shares = [[self.bm.m.field(0)] * rows for _ in range(2)]
         accept_all = np.zeros(self.num_reports, bool)
@@ -484,17 +563,27 @@ class ChunkedIncrementalRunner(RoundPrograms):
         wc_ok_all = (np.zeros(self.num_reports, bool)
                      if do_weight_check else None)
         jr_ok_all: Optional[np.ndarray] = None
-        chunk_stats = []
-        evals_per_report = 2 * plan.parent_count * 2  # both parties
+        warm_args: list = [None]
+        warm_spent: list = [0.0]
 
-        for (i, cs) in enumerate(self.chunks):
+        def stage(i: int):
+            """Upload chunk i and dispatch its full device chain —
+            returns futures only, no blocking sync."""
+            cs = self.chunks[i]
             t0 = time.perf_counter()
             (batch, live) = self.store.device_chunk(i)
             (lo, hi) = self.store.chunk_bounds(i)
+            # The aggregation validity mask, known at stage time: live
+            # (non-padding) lanes whose device carry was intact BEFORE
+            # this round.  This round's ok / wc_ok fold in on device,
+            # reproducing the serial path's fallback-then-mask order.
+            valid = live.copy()
+            valid[:hi - lo] &= ~self.fallback[lo:hi]
             dev_c0 = _carry_to_device(cs.carries[0])
             dev_c1 = _carry_to_device(cs.carries[1])
             ext_rk = jnp.asarray(cs.ext_rk)
             conv_rk = jnp.asarray(cs.conv_rk)
+            valid_dev = jnp.asarray(valid)
             if self.mesh is not None:
                 # Chunk upload lands report-sharded across the mesh;
                 # aggregation below is the only cross-chip collective.
@@ -503,46 +592,119 @@ class ChunkedIncrementalRunner(RoundPrograms):
                     place_reports(self.mesh,
                                   (batch, dev_c0, dev_c1, ext_rk,
                                    conv_rk))
-            (c0, c1, out0, out1, accept, ok) = eval_fn(
-                vk_arr, dev_c0, dev_c1, rnd, ext_rk, conv_rk,
-                batch.cws)
+            t_up = time.perf_counter()
+            args = (vk_arr, dev_c0, dev_c1, rnd, ext_rk, conv_rk,
+                    batch.cws)
+            (eval_prog, compile_s) = self._eval_program(
+                chunk_size, plan, args)
+            t_d0 = time.perf_counter()
+            (c0, c1, out0, out1, accept_ev, ok) = eval_prog(*args)
+            wc_checks = {}
+            (wc_accept, wc_okdev, jr) = (ones, ones, ones)
+            if do_weight_check:
+                (wc_checks, wc_okdev) = self._wc_fn(level)(
+                    vk_arr, batch, c0.w[:, 0, :2], c1.w[:, 0, :2])
+                wc_accept = wc_checks["weight_check"]
+                jr = wc_checks.get("joint_rand", ones)
+            cargs = (out0, out1, accept_ev, ok, valid_dev,
+                     wc_accept, wc_okdev, jr)
+            (agg_prog, agg_compile_s) = self._agg_program(
+                chunk_size, cargs)
+            (accept_dev, agg0, agg1) = agg_prog(*cargs)
+            t_d1 = time.perf_counter()
+            if warm_args[0] is None:
+                warm_args[0] = args  # shape template for _warm_next
+            compile_ms = (compile_s + agg_compile_s) * 1e3
+            phases = {
+                "upload_ms": round((t_up - t0) * 1e3, 3),
+                "compile_ms": round(compile_ms, 3),
+                "dispatch_ms": round(
+                    (t_d1 - t_d0 - compile_s - agg_compile_s) * 1e3,
+                    3),
+            }
+            handle = (c0, c1, accept_ev, ok, wc_checks, wc_okdev,
+                      accept_dev, agg0, agg1)
+            return (handle, phases)
+
+        def collect(i: int, handle) -> dict:
+            """Chunk i's single blocking sync, downloads, host fold."""
+            (c0, c1, accept_ev, ok, wc_checks, wc_okdev,
+             accept_dev, agg0, agg1) = handle
+            cs = self.chunks[i]
+            (lo, hi) = self.store.chunk_bounds(i)
+            t0 = time.perf_counter()
+            jax.block_until_ready(
+                (c0, c1, accept_ev, ok, wc_checks, wc_okdev,
+                 accept_dev, agg0, agg1))
+            t_wait = time.perf_counter()
             cs.carries[0] = _carry_to_host(c0)
             cs.carries[1] = _carry_to_host(c1)
-            ok = np.asarray(ok)
-            self.fallback[lo:hi] |= ~ok[:hi - lo]
-
-            accept = np.asarray(accept).copy()
-            eval_ok_all[lo:hi] = accept[:hi - lo]
+            ok_np = np.asarray(ok)
+            accept_ev_np = np.asarray(accept_ev)
+            accept_np = np.asarray(accept_dev)
+            agg_np = [np.asarray(agg0), np.asarray(agg1)]
+            wc_np = {k: np.asarray(v) for (k, v) in wc_checks.items()}
+            wc_ok_np = (np.asarray(wc_okdev) if do_weight_check
+                        else None)
+            t_down = time.perf_counter()
+            self.fallback[lo:hi] |= ~ok_np[:hi - lo]
+            eval_ok_all[lo:hi] = accept_ev_np[:hi - lo]
             if do_weight_check:
-                (wc_checks, wc_ok) = self._wc_fn(level)(
-                    vk_arr, batch, c0.w[:, 0, :2], c1.w[:, 0, :2])
-                self.fallback[lo:hi] |= ~np.asarray(wc_ok)[:hi - lo]
-                wc_accept = np.asarray(wc_checks["weight_check"])
-                wc_ok_all[lo:hi] = wc_accept[:hi - lo]
-                if "joint_rand" in wc_checks:
-                    jr = np.asarray(wc_checks["joint_rand"])
+                self.fallback[lo:hi] |= ~wc_ok_np[:hi - lo]
+                wc_ok_all[lo:hi] = wc_np["weight_check"][:hi - lo]
+                if "joint_rand" in wc_np:
+                    nonlocal jr_ok_all
                     if jr_ok_all is None:
                         jr_ok_all = np.zeros(self.num_reports, bool)
-                    jr_ok_all[lo:hi] = jr[:hi - lo]
-                    wc_accept = wc_accept & jr
-                accept &= wc_accept
-
-            valid = live.copy()
-            valid[:hi - lo] &= ~self.fallback[lo:hi]
-            accept &= valid
-            (agg0, agg1) = agg_fn(out0, out1, jnp.asarray(accept))
-            for (a, arr) in ((0, agg0), (1, agg1)):
+                    jr_ok_all[lo:hi] = wc_np["joint_rand"][:hi - lo]
+            for a in range(2):
                 agg_shares[a] = vec_add(
                     agg_shares[a],
-                    self.bm.agg_share_to_host(arr[:rows]))
-            accept_all[lo:hi] = accept[:hi - lo]
-            wall = time.perf_counter() - t0
-            chunk_stats.append({
-                "chunk": i, "reports": hi - lo,
-                "wall_ms": round(wall * 1e3, 2),
-                "node_evals_per_sec": round(
-                    self.store.chunk_size * evals_per_report / wall, 1),
-            })
+                    self.bm.agg_share_to_host(agg_np[a][:rows]))
+            accept_all[lo:hi] = accept_np[:hi - lo]
+            t_host = time.perf_counter()
+            return {
+                "compute_wait_ms": round((t_wait - t0) * 1e3, 3),
+                "download_ms": round((t_down - t_wait) * 1e3, 3),
+                "host_ms": round((t_host - t_down) * 1e3, 3),
+            }
+
+        def warm_predicted() -> None:
+            # Every chunk's device work is dispatched and the host is
+            # about to idle in the final blocking sync: compile the
+            # predicted next level's programs while the device
+            # computes through them (see pipeline.ProgramCache for
+            # why this is synchronous, not a compiler thread).
+            warm_spent[0] = self._warm_next(plan, warm_args[0],
+                                            chunk_size)
+
+        from .pipeline import paused_gc
+        with paused_gc():
+            # GC paused for the chunk loop: its traces (first-call
+            # jits, inline lowers) segfault this jaxlib if a
+            # collection fires mid-trace (pipeline.paused_gc).
+            (timeline, wall_ms) = run_chunks(
+                self.store.num_chunks, stage, collect,
+                pipelined=(mode == "pipelined"),
+                before_last_collect=warm_predicted)
+
+        evals_per_report = 2 * plan.parent_count * 2  # both parties
+        for rec in timeline:
+            (lo, hi) = self.store.chunk_bounds(rec["chunk"])
+            span_s = max(rec["collect_end_ms"]
+                         - rec["stage_start_ms"], 1e-3) / 1e3
+            rec["reports"] = hi - lo
+            rec["wall_ms"] = round(span_s * 1e3, 2)
+            # Live-report rate (comparable across full and partial
+            # chunks) AND the padded device-work rate — the tail chunk
+            # computes chunk_size padded lanes but only hi-lo of them
+            # are reports, so the old single padded-rate stamp
+            # overstated tail throughput.
+            rec["node_evals_per_sec"] = round(
+                (hi - lo) * evals_per_report / span_s, 1)
+            rec["node_evals_per_sec_padded"] = round(
+                chunk_size * evals_per_report / span_s, 1)
+        chunk_stats = timeline
 
         assert level == len(self.layouts)
         self.layouts.append(plan.layout_new)
@@ -560,6 +722,19 @@ class ChunkedIncrementalRunner(RoundPrograms):
                           self.num_reports)
         metrics.extra["chunks"] = chunk_stats
         metrics.extra["memory"] = self.memory_accounting()
+        compile_inline_ms = sum(rec["phases"].get("compile_ms", 0.0)
+                                for rec in timeline)
+        metrics.extra["pipeline"] = {
+            "mode": mode,
+            "fallback": fb_reason,
+            "round_wall_ms": round(wall_ms, 2),
+            "overlap_efficiency": overlap_efficiency(timeline,
+                                                     wall_ms),
+            "compile_inline_ms": round(compile_inline_ms, 2),
+            "warm_ms": round(warm_spent[0] * 1e3, 2),
+            "aot": self._aot_summary(chunk_size, plan,
+                                     compile_inline_ms),
+        }
 
         splice_rejected(self.bm.m, self.verify_key, self.ctx, agg_param,
                         self.reports, ~self.fallback, accept_all,

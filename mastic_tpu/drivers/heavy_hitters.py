@@ -543,7 +543,7 @@ class HeavyHittersRun:
                 runner.width = width
                 runner.engine = IncrementalMastic(runner.bm, width)
                 runner._eval_fn = None
-                runner._agg_fn = None
+                runner._combine_fn = None
             runner.fallback = np.asarray(arrays["fallback"], bool)
             runner.load_state(arrays, runner.store.num_chunks)
             runner.layouts = restored_layouts()
@@ -560,7 +560,7 @@ class HeavyHittersRun:
                 runner.width = width
                 runner.engine = IncrementalMastic(runner.bm, width)
                 runner._eval_fn = None
-                runner._agg_fn = None
+                runner._combine_fn = None
             runner.fallback = np.asarray(arrays["fallback"], bool)
             runner.carries = [
                 carry_from_arrays(arrays, "c0_"),
@@ -575,15 +575,47 @@ class HeavyHittersRun:
 
 
 class RoundPrograms:
-    """Shared jitted-program cache for the incremental runners.
+    """Shared round-program machinery for the incremental runners.
 
     The resident (_IncrementalRunner) and chunked
     (drivers/chunked.ChunkedIncrementalRunner) runners execute the
     identical round program — one definition keeps their semantics
     locked together.  Subclasses provide bm / verify_key / ctx /
-    engine / width / layouts and a _grow(width)."""
+    engine / width / layouts / mesh and a _grow(width), and call
+    _init_programs() from __init__.
 
-    def _fns(self):
+    Two program tiers:
+
+    * `_eval_jit` / `_combine_jit` — the jitted functions (the mesh
+      path calls them directly: GSPMD needs jit's sharding
+      propagation);
+    * `self.programs` (drivers/pipeline.ProgramCache) — ahead-of-time
+      compiled executables keyed by the shapes each round actually
+      closes over (chunk rows, padded width, the pow2 binder/out
+      buckets).  Shape-keying makes width growth safe by
+      construction: a grown round's key differs, so no invalidation
+      step can be forgotten (the r5..r8 code cleared `_eval_fn` /
+      `_agg_fn` on _grow but left `_wc_fns` — benign only because
+      the weight-check program's input shapes happen to be
+      width-independent; tests/test_pipeline.py locks the
+      grow-then-weight-check path either way).  `_warm_next`
+      compiles the predicted next level's programs while the current
+      round's dispatched device work is still executing (PERF.md:
+      the measured ~100 s of inline compile in the production
+      round); see ProgramCache for why this is synchronous rather
+      than a compiler thread.
+    """
+
+    def _init_programs(self) -> None:
+        from .pipeline import ProgramCache
+
+        self._eval_fn = None
+        self._combine_fn = None
+        self._wc_fns: dict = {}
+        self.programs = ProgramCache()
+        self._warmed_keys: set = set()
+
+    def _eval_jit(self):
         if self._eval_fn is None:
             engine = self.engine
             ctx = self.ctx
@@ -601,13 +633,113 @@ class RoundPrograms:
             # fresh buffers every chunk).  The verify key is traced so
             # a fresh per-collection key reuses the compiled program.
             self._eval_fn = jax.jit(both, donate_argnums=(1, 2))
+        return self._eval_fn
 
-            def agg(out0, out1, accept):
-                return (self.bm.aggregate(out0, accept),
-                        self.bm.aggregate(out1, accept))
+    def _combine_jit(self):
+        """Accept-mask combine + masked aggregation, fully on device:
+        the pipelined round's replacement for the host-side boolean
+        folds that forced a blocking `np.asarray` wall between the
+        tree step and the aggregate.  Rounds without a weight check
+        pass all-ones for the three wc masks, so one program
+        signature serves every level-kind; limb arithmetic is exact
+        modular integer math, so the fused masked sum is bit-equal to
+        the old standalone aggregate."""
+        if self._combine_fn is None:
+            bm = self.bm
 
-            self._agg_fn = jax.jit(agg)
-        return (self._eval_fn, self._agg_fn)
+            def combine(out0, out1, accept_eval, ok, valid,
+                        wc_accept, wc_ok, jr):
+                accept = (accept_eval & ok & valid
+                          & wc_accept & wc_ok & jr)
+                return (accept, bm.aggregate(out0, accept),
+                        bm.aggregate(out1, accept))
+
+            self._combine_fn = jax.jit(combine)
+        return self._combine_fn
+
+    # -- shape-keyed AOT programs (drivers/pipeline.py) ------------
+
+    def _eval_key(self, rows: int, plan) -> tuple:
+        from .pipeline import plan_shape_key
+
+        return ("eval", rows) + plan_shape_key(plan)
+
+    def _agg_key(self, rows: int, out_cols: int) -> tuple:
+        return ("agg", rows, out_cols)
+
+    def _eval_program(self, rows: int, plan, args) -> tuple:
+        """(program, compile_wait_seconds) for this round's eval.
+        Mesh runs stay on the jitted path (AOT lowering would need
+        explicit shardings); single-device runs get the cached
+        executable, compiled inline only when prediction missed."""
+        if self.mesh is not None:
+            return (self._eval_jit(), 0.0)
+        return self.programs.get(
+            self._eval_key(rows, plan),
+            lambda: self._eval_jit().lower(*args))
+
+    def _agg_program(self, rows: int, cargs) -> tuple:
+        if self.mesh is not None:
+            return (self._combine_jit(), 0.0)
+        return self.programs.get(
+            self._agg_key(rows, cargs[0].shape[1]),
+            lambda: self._combine_jit().lower(*cargs))
+
+    def _warm_next(self, plan, args, rows: int) -> float:
+        """Ahead-of-time compile the predicted next level's (bucket,
+        width) programs.  Called at the point where every in-flight
+        chunk's device work is already dispatched and the host is
+        about to idle in the round's blocking sync, so the XLA work
+        overlaps device execution (async dispatch keeps the device
+        computing through it).  Lowering signatures are built from
+        this round's concrete args with the predicted plan's
+        traced-input shapes swapped in — no device memory is touched.
+        Returns the seconds spent (the timeline's warm_ms)."""
+        from ..backend.incremental import round_inputs
+        from . import pipeline as pl
+
+        if self.mesh is not None or not pl.pipeline_enabled():
+            return 0.0
+        structs = jax.tree_util.tree_map(pl.to_struct, args)
+        layouts_next = list(self.layouts) + [plan.layout_new]
+        out_len = 1 + self.bm.m.flp.OUTPUT_LEN
+        n = self.bm.spec.num_limbs
+        eval_jit = self._eval_jit()
+        combine_jit = self._combine_jit()
+        spent = 0.0
+        for nplan in pl.predicted_next_plans(
+                plan.prefixes, plan.level, self.bm.m.vidpf.BITS,
+                self.width, layouts_next):
+            nrnd = jax.tree_util.tree_map(pl.to_struct,
+                                          round_inputs(nplan))
+            eargs = structs[:3] + (nrnd,) + structs[4:]
+            ekey = self._eval_key(rows, nplan)
+            self._warmed_keys.add(ekey)
+            spent += self.programs.warm(
+                ekey, lambda: eval_jit.lower(*eargs))
+            out_cols = len(nplan.out_idx) * out_len
+            s_out = jax.ShapeDtypeStruct((rows, out_cols, n),
+                                         jnp.uint32)
+            s_mask = jax.ShapeDtypeStruct((rows,), jnp.bool_)
+            cargs = (s_out, s_out) + (s_mask,) * 6
+            akey = self._agg_key(rows, out_cols)
+            self._warmed_keys.add(akey)
+            spent += self.programs.warm(
+                akey, lambda: combine_jit.lower(*cargs))
+        return spent
+
+    def _aot_summary(self, rows: int, plan,
+                     compile_wait_ms: float) -> dict:
+        """The round's AOT record for RoundMetrics.extra: whether the
+        eval key had been predicted+warmed, what the cache has done so
+        far, and the compile wait this round actually paid."""
+        key = self._eval_key(rows, plan)
+        return {
+            "eval_key": "x".join(str(k) for k in key[1:]),
+            "predicted": key in self._warmed_keys,
+            "compile_wait_ms": round(compile_wait_ms, 2),
+            **self.programs.stats,
+        }
 
     def _wc_fn(self, level: int):
         fn = self._wc_fns.get(level)
@@ -666,9 +798,7 @@ class _IncrementalRunner(RoundPrograms):
             for a in range(2)
         ]
         self.layouts: list = []  # per-depth creation layouts
-        self._eval_fn = None
-        self._agg_fn = None
-        self._wc_fns: dict = {}
+        self._init_programs()
 
     def memory_accounting(self) -> dict:
         """Device-resident footprint: both carries, the round keys and
@@ -709,11 +839,22 @@ class _IncrementalRunner(RoundPrograms):
                             for c in self.carries]
         self.width = width
         self.engine = IncrementalMastic(self.bm, width)
+        # The AOT programs (self.programs) key on the shapes they
+        # close over, so the grown width simply maps to fresh keys —
+        # only the jitted closures (which capture the engine) need
+        # rebinding.
         self._eval_fn = None
-        self._agg_fn = None
+        self._combine_fn = None
 
     def round(self, agg_param,
               metrics_out: Optional[list] = None) -> list:
+        """One resident round, pipelined-executor style: the whole
+        eval -> weight-check -> mask-combine -> aggregate chain is
+        dispatched asynchronously (device-side accept combine instead
+        of host boolean folds), the predicted next level's programs
+        warm in the background, and ONE blocking sync collects
+        everything — the per-phase timeline lands in
+        `RoundMetrics.extra["pipeline"]`."""
         from ..backend.incremental import round_inputs
         from .chunked import check_round_peak
 
@@ -726,37 +867,74 @@ class _IncrementalRunner(RoundPrograms):
             self.memory_accounting()["device_bytes_total"], level,
             (self.mesh.shape["reports"]
              if self.mesh is not None else 1))
-        (eval_fn, agg_fn) = self._fns()
-        (c0, c1, out0, out1, accept, ok) = eval_fn(
-            _vk_array(self.verify_key),
-            self.carries[0], self.carries[1], round_inputs(plan),
-            self.ext_rk, self.conv_rk, self.batch.cws)
-        self.fallback |= ~np.asarray(ok)
+        from .pipeline import paused_gc
+
+        t0 = time.perf_counter()
+        with paused_gc():
+            # GC paused for the dispatch window: its traces segfault
+            # this jaxlib if a collection fires mid-trace
+            # (pipeline.paused_gc).
+            rnd = round_inputs(plan)
+            vk_arr = _vk_array(self.verify_key)
+            valid = jnp.asarray(~self.fallback)
+            ones = jnp.ones(self.num_reports, bool)
+            t_up = time.perf_counter()
+
+            args = (vk_arr, self.carries[0], self.carries[1], rnd,
+                    self.ext_rk, self.conv_rk, self.batch.cws)
+            (eval_prog, compile_s) = self._eval_program(
+                self.num_reports, plan, args)
+            t_disp0 = time.perf_counter()
+            (c0, c1, out0, out1, accept_ev, ok) = eval_prog(*args)
+            wc_checks = {}
+            (wc_accept, wc_okdev, jr) = (ones, ones, ones)
+            if do_weight_check:
+                # FLP weight check on the depth-0 payload rows the
+                # tree program just computed (rows 0..1 of depth 0 are
+                # always the two root children) — a small FLP-only
+                # program, not a second from-root tree eval.
+                (wc_checks, wc_okdev) = self._wc_fn(level)(
+                    vk_arr, self.batch, c0.w[:, 0, :2],
+                    c1.w[:, 0, :2])
+                wc_accept = wc_checks["weight_check"]
+                jr = wc_checks.get("joint_rand", ones)
+            cargs = (out0, out1, accept_ev, ok, valid,
+                     wc_accept, wc_okdev, jr)
+            (agg_prog, agg_compile_s) = self._agg_program(
+                self.num_reports, cargs)
+            (accept_dev, agg0, agg1) = agg_prog(*cargs)
+            t_disp1 = time.perf_counter()
+            # Everything is dispatched; the device computes while the
+            # host compiles the predicted next level's programs.
+            warm_s = self._warm_next(plan, args, self.num_reports)
+        t_warm = time.perf_counter()
         self.carries = [c0, c1]
         assert level == len(self.layouts)
         self.layouts.append(plan.layout_new)
+
+        # The round's single blocking sync: everything above is an
+        # in-flight future until here.
+        jax.block_until_ready(
+            (accept_dev, agg0, agg1, ok, wc_okdev))
+        t_wait = time.perf_counter()
+        checks = {"eval_proof": np.asarray(accept_ev)}
+        checks.update({k: np.asarray(v)
+                       for (k, v) in wc_checks.items()})
+        self.fallback |= ~np.asarray(ok)
+        if do_weight_check:
+            self.fallback |= ~np.asarray(wc_okdev)
+        accept = np.asarray(accept_dev).copy()
+        rows = len(prefixes) * (1 + self.bm.m.flp.OUTPUT_LEN)
+        agg_shares = [
+            self.bm.agg_share_to_host(np.asarray(a)[:rows])
+            for a in (agg0, agg1)
+        ]
+        t_down = time.perf_counter()
 
         metrics = RoundMetrics(level=level,
                                frontier_width=len(prefixes),
                                padded_width=self.width,
                                reports_total=self.num_reports)
-        checks = {"eval_proof": np.asarray(accept)}
-        if do_weight_check:
-            # FLP weight check on the depth-0 payload rows the tree
-            # program just computed (rows 0..1 of depth 0 are always
-            # the two root children) — a small FLP-only program, not a
-            # second from-root tree eval.
-            (wc_checks, wc_ok) = self._wc_fn(level)(
-                _vk_array(self.verify_key), self.batch,
-                c0.w[:, 0, :2], c1.w[:, 0, :2])
-            self.fallback |= ~np.asarray(wc_ok)
-            checks.update({k: np.asarray(v)
-                           for (k, v) in wc_checks.items()})
-            wc_accept = np.asarray(wc_checks["weight_check"])
-            if "joint_rand" in wc_checks:
-                wc_accept = wc_accept & np.asarray(
-                    wc_checks["joint_rand"])
-            accept = jnp.asarray(accept) & jnp.asarray(wc_accept)
         attribute_rejections(metrics, checks["eval_proof"],
                              checks.get("weight_check"),
                              checks.get("joint_rand"),
@@ -768,18 +946,32 @@ class _IncrementalRunner(RoundPrograms):
         count_round_bytes(metrics, self.bm.m, agg_param,
                           self.num_reports)
 
-        accept = jnp.asarray(accept) & jnp.asarray(~self.fallback)
-        (agg0, agg1) = agg_fn(out0, out1, accept)
-        rows = len(prefixes) * (1 + self.bm.m.flp.OUTPUT_LEN)
-        agg_shares = [
-            self.bm.agg_share_to_host(a[:rows]) for a in (agg0, agg1)
-        ]
-        accept = np.asarray(accept).copy()
         splice_rejected(self.bm.m, self.verify_key, self.ctx, agg_param,
                         self.reports, ~self.fallback, accept, agg_shares)
         metrics.accepted = int(accept.sum())
         metrics.xof_fallbacks = int(self.fallback.sum())
         metrics.rejected_fallback = int((self.fallback & ~accept).sum())
+        t_host = time.perf_counter()
+        compile_ms = (compile_s + agg_compile_s) * 1e3
+        metrics.extra["pipeline"] = {
+            "mode": "resident-deferred",
+            "fallback": "mesh" if self.mesh is not None else None,
+            "overlap_efficiency": 0.0,  # one chunk: nothing to overlap
+            "compile_inline_ms": round(compile_ms, 2),
+            "phases": {
+                "upload_ms": round((t_up - t0) * 1e3, 3),
+                "compile_ms": round(compile_ms, 3),
+                "dispatch_ms": round(
+                    (t_disp1 - t_disp0 - agg_compile_s) * 1e3, 3),
+                "warm_ms": round(warm_s * 1e3, 3),
+                "compute_wait_ms": round((t_wait - t_warm) * 1e3, 3),
+                "download_ms": round((t_down - t_wait) * 1e3, 3),
+                "host_ms": round((t_host - t_down) * 1e3, 3),
+            },
+            "host_syncs": 1,
+            "aot": self._aot_summary(self.num_reports, plan,
+                                     compile_ms),
+        }
         if metrics_out is not None:
             metrics_out.append(metrics)
         num = int(accept.sum())

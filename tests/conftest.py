@@ -24,16 +24,24 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 import jax  # noqa: E402  (after the env setup above, by design)
 
 jax.config.update("jax_platforms", "cpu")
-# Persistent compilation cache: XLA-CPU executables DO serialize in
-# this jax build, but only when all three knobs are set through
-# jax.config (the env vars are not picked up).  With the floors
-# dropped, the first suite run pays every compile once per machine and
-# reruns hit the disk cache (measured ~10x faster second runs).
-jax.config.update("jax_compilation_cache_dir",
-                  os.environ.get("JAX_COMPILATION_CACHE_DIR",
-                                 "/tmp/mastic_tpu_jax_cache"))
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+# Persistent compilation cache: REMOVED in r9.  XLA-CPU executables
+# serialize, but RELOADING them is unsound in this jaxlib: a process
+# that reads a warm cache segfaults mid-run or — strictly worse —
+# loads a program that silently computes the wrong thing (observed: a
+# round program that rejected every report).  Reproduced on the
+# UNMODIFIED pre-r9 tree via a git-worktree A/B (PERF.md §7), so this
+# is a fabric deserialization bug, not a property of any one change;
+# the "~10x faster reruns" the cache bought are not worth wrong
+# crypto.  bench.py / tools/northstar.py now gate the same wiring to
+# chip platforms (MASTIC_COMPILE_CACHE forces it); tests always
+# compile cold.  Opt back in explicitly at your own risk:
+if os.environ.get("MASTIC_COMPILE_CACHE") == "1":
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                                     "/tmp/mastic_tpu_jax_cache"))
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      0.0)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

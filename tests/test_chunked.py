@@ -246,6 +246,9 @@ def test_memory_envelope_guard(monkeypatch) -> None:
     mem = run.runner.memory_accounting()
     assert env["device_bytes_per_chunk"] == mem["device_bytes_per_chunk"]
     assert env["host_bytes_total"] == mem["host_bytes_total"]
+    # The pipelined-residency term is exactly two chunks in flight.
+    assert env["device_bytes_per_chunk_pipelined"] == \
+        2 * mem["device_bytes_per_chunk"]
 
     # A budget below even one report's footprint: the width itself is
     # infeasible and the message must say so (not "shrink to 0").

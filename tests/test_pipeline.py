@@ -1,0 +1,654 @@
+"""Pipelined chunk-streaming executor (ISSUE 4, `MASTIC_PIPELINE`):
+serial bit-identity (agg shares, metrics counters, checkpoint state)
+across 1/2/3-chunk stores with a partial tail, measured overlap via
+the phase timeline under injected store latency, ahead-of-time bucket
+compilation (zero inline compile for predicted buckets, correct
+inline compile on misses), the two-chunks-in-flight envelope term
+with the degrade-to-serial budget fallback, and composition with
+checkpoint kill-resume under an armed `MASTIC_FAULTS` lever.
+
+Fast tier (run via `make pipeline`, wired into `make ci`); the
+process-separated session composition runs in the slow tier.
+"""
+
+import io
+import time
+
+import numpy as np
+import pytest
+
+from mastic_tpu.backend.mastic_jax import BatchedMastic
+from mastic_tpu.common import gen_rand
+from mastic_tpu.drivers import pipeline
+from mastic_tpu.drivers.chunked import (PIPELINE_CHUNKS_IN_FLIGHT,
+                                        HostReportStore,
+                                        memory_envelope,
+                                        round_peak_bytes)
+from mastic_tpu.drivers.heavy_hitters import (
+    HeavyHittersRun, get_reports_from_measurements)
+from mastic_tpu.mastic import MasticCount
+
+CTX = b"pipeline test"
+
+
+# NOTE: the suite runs with the persistent XLA compile cache OFF
+# (tests/conftest.py): on this CPU fabric, reloading cached
+# executables is unsound — a warm process segfaults or loads a
+# silently wrong program (reproduced at the PRE-pipeline HEAD, so it
+# is a fabric landmine, not a pipeline regression; PERF.md §7 records
+# the experiment, and northstar/bench now platform-gate the same
+# wiring).  Every runner here compiles cold, which is also what the
+# AOT assertions need.
+
+
+def _tampered_reports(m):
+    """10 reports over 3-bit values [0 x3, 5 x3, 3, 1, 6 x2]; report 4
+    (a 5) fails the eval proof, report 7 (the 1) fails the weight
+    check — rejection attribution must survive pipelining."""
+    meas = [((bool(v >> 2 & 1), bool(v >> 1 & 1), bool(v & 1)), True)
+            for v in [0, 0, 0, 5, 5, 5, 3, 1, 6, 6]]
+    reports = get_reports_from_measurements(m, CTX, meas)
+    (nonce, ps, shares) = reports[4]
+    (key, proof, seed, part) = shares[0]
+    reports[4] = (nonce, ps, [
+        (bytes([key[0] ^ 1]) + key[1:], proof, seed, part), shares[1]])
+    (nonce, ps, shares) = reports[7]
+    (key, proof, seed, part) = shares[0]
+    bad_proof = [proof[0] + m.field(1)] + proof[1:]
+    reports[7] = (nonce, ps, [(key, bad_proof, seed, part), shares[1]])
+    return reports
+
+
+def _clean_reports(m):
+    """7 reports: 0 x3, 7 x3, 3 x1 — hitters {000, 111} at
+    threshold 2, frontier steady at 4 from level 1 (one surviving
+    child per parent: the AOT predictor's fixed point)."""
+    meas = [(m.vidpf.test_index_from_int(v, 3), True)
+            for v in (0, 0, 0, 7, 7, 7, 3)]
+    return get_reports_from_measurements(m, CTX, meas)
+
+
+def _ckpt_arrays(blob: bytes) -> dict:
+    return dict(np.load(io.BytesIO(blob), allow_pickle=False))
+
+
+def _assert_state_equal(blob_a: bytes, blob_b: bytes) -> None:
+    """Checkpoint state equality, array for array.  (The raw npz
+    container embeds zip-entry mtimes, so literal blob equality is
+    time-of-day-dependent; the arrays ARE the state.)"""
+    (a, b) = (_ckpt_arrays(blob_a), _ckpt_arrays(blob_b))
+    assert sorted(a) == sorted(b)
+    for k in a:
+        assert np.array_equal(a[k], b[k]), f"checkpoint array {k}"
+
+
+def _run_all(run) -> None:
+    while run.step():
+        pass
+
+
+def _counters(metrics) -> list:
+    return [(m.level, m.accepted, m.rejected_eval_proof,
+             m.rejected_weight_check, m.rejected_joint_rand,
+             m.rejected_fallback, m.xof_fallbacks, m.node_evals,
+             m.padded_width) for m in metrics]
+
+
+# -- executor + cache host-level units (no device work) --------------
+
+
+def test_run_chunks_ordering():
+    log = []
+
+    def stage(i):
+        log.append(("stage", i))
+        return (i * 10, {"upload_ms": 1.0})
+
+    def collect(i, handle):
+        assert handle == i * 10
+        log.append(("collect", i))
+        return {"host_ms": 1.0}
+
+    (tl, _wall) = pipeline.run_chunks(3, stage, collect,
+                                      pipelined=False)
+    assert log == [("stage", 0), ("collect", 0), ("stage", 1),
+                   ("collect", 1), ("stage", 2), ("collect", 2)]
+    assert [rec["host_syncs"] for rec in tl] == [1, 1, 1]
+
+    log.clear()
+    (tl, _wall) = pipeline.run_chunks(3, stage, collect,
+                                      pipelined=True)
+    # Double buffering: chunk i+1 stages BEFORE chunk i collects.
+    assert log == [("stage", 0), ("stage", 1), ("collect", 0),
+                   ("stage", 2), ("collect", 1), ("collect", 2)]
+    for i in range(2):
+        assert tl[i + 1]["stage_start_ms"] < tl[i]["collect_start_ms"]
+    assert all(rec["phases"]["upload_ms"] == 1.0 for rec in tl)
+
+
+def test_overlap_efficiency_math():
+    tl = [{"phases": {"upload_ms": 10.0, "compute_wait_ms": 10.0}},
+          {"phases": {"upload_ms": 10.0, "compute_wait_ms": 10.0}}]
+    assert pipeline.overlap_efficiency(tl, 40.0) == 0.0  # serial
+    assert pipeline.overlap_efficiency(tl, 20.0) == 0.5  # half hidden
+    assert pipeline.overlap_efficiency(tl, 0.0) == 0.0
+
+
+class _FakeLowered:
+    def __init__(self, tag, delay=0.0, fail=False):
+        (self.tag, self.delay, self.fail) = (tag, delay, fail)
+
+    def compile(self):
+        if self.delay:
+            time.sleep(self.delay)
+        if self.fail:
+            raise RuntimeError("boom")
+        return ("compiled", self.tag)
+
+
+def test_program_cache_inline_warm_and_errors():
+    cache = pipeline.ProgramCache()
+    (prog, wait) = cache.get("k1", lambda: _FakeLowered(1))
+    assert prog == ("compiled", 1) and wait > 0.0
+    (prog, wait) = cache.get("k1", lambda: _FakeLowered(99))
+    assert prog == ("compiled", 1) and wait == 0.0  # cached: no wait
+    assert cache.stats["inline_compiles"] == 1
+
+    assert cache.warm("k2", lambda: _FakeLowered(2, delay=0.01)) > 0.0
+    assert cache.warm("k2", lambda: _FakeLowered(3)) == 0.0  # dedup
+    (prog, wait) = cache.get("k2", lambda: _FakeLowered(4))
+    assert prog == ("compiled", 2) and wait == 0.0  # warmed: free
+    assert cache.stats["warm_compiles"] == 1
+
+    # A failing warm is counted, never raised; the round that needs
+    # the key compiles inline afterwards.
+    cache.warm("k3", lambda: _FakeLowered(5, fail=True))
+    assert cache.stats["warm_errors"] == 1
+    (prog, _wait) = cache.get("k3", lambda: _FakeLowered(6))
+    assert prog == ("compiled", 6)
+    assert not cache.contains("k4")
+
+
+def test_predicted_next_plans_candidates():
+    from mastic_tpu.backend.incremental import RoundPlan
+
+    bits = 6
+    # Level-1 frontier: both children of both root children.
+    prefixes = [(a, b) for a in (False, True) for b in (False, True)]
+    layouts = [[(False,), (True,)]]
+    plan = RoundPlan(tuple(prefixes), 1, bits, 8, layouts)
+    nxt = pipeline.predicted_next_plans(
+        plan.prefixes, 1, bits, 8, layouts + [plan.layout_new])
+    keys = {pipeline.plan_shape_key(p) for p in nxt}
+    assert len(nxt) == len(keys)  # deduplicated by shape
+    # Growth candidate: all 4 survive -> 8 prefixes (out bucket 8);
+    # steady candidate: one child per parent -> 4 (out bucket 4).
+    assert {k[3] for k in keys} == {4, 8}
+    # Last level: nothing to predict.
+    assert pipeline.predicted_next_plans(
+        plan.prefixes, bits - 1, bits, 8, layouts) == []
+    # Candidates that would overflow the padded width are skipped
+    # (the grow round compiles inline by design): width 4 holds only
+    # 2 ancestor slots, the growth candidate needs 4.
+    nxt_small = pipeline.predicted_next_plans(
+        plan.prefixes, 1, bits, 4, layouts + [plan.layout_new])
+    assert {pipeline.plan_shape_key(p)[3] for p in nxt_small} <= {4}
+
+
+# -- bit-identity: pipelined vs serial across chunk layouts ----------
+
+
+@pytest.mark.parametrize("chunk_size,num_chunks", [
+    # single chunk (serial fallback named "single-chunk")
+    pytest.param(12, 1, marks=pytest.mark.slow),
+    # two chunks, no tail padding
+    pytest.param(5, 2, marks=pytest.mark.slow),
+    (4, 3),    # three chunks, partial tail (2 live of 4 padded)
+], ids=["1chunk", "2chunk", "3chunk-tail"])
+def test_pipelined_matches_serial(monkeypatch, chunk_size,
+                                  num_chunks) -> None:
+    m = MasticCount(3)
+    reports = _tampered_reports(m)
+    vk = gen_rand(m.VERIFY_KEY_SIZE)
+    thresholds = {"default": 2}
+
+    def full_run(lever):
+        monkeypatch.setenv("MASTIC_PIPELINE", lever)
+        run = HeavyHittersRun(m, CTX, thresholds, reports,
+                              verify_key=vk, chunk_size=chunk_size)
+        _run_all(run)
+        return run
+
+    serial = full_run("0")
+    piped = full_run("1")
+    assert serial.store.num_chunks == num_chunks
+
+    # Same verdicts, counters and aggregates at every level; the
+    # carried state (what every later round derives from) is
+    # bit-identical in the checkpoint arrays.
+    assert _counters(serial.metrics) == _counters(piped.metrics)
+    assert serial.result() == piped.result()
+    assert serial.result()  # the honest hitters survive
+    _assert_state_equal(serial.to_bytes(), piped.to_bytes())
+
+    # Modes are honest: overlap only with >1 chunk and lever on.
+    ser_pl = serial.metrics[0].extra["pipeline"]
+    pip_pl = piped.metrics[0].extra["pipeline"]
+    assert ser_pl["mode"] == "serial"
+    assert ser_pl["fallback"] == "lever-off"
+    if num_chunks == 1:
+        assert pip_pl == dict(pip_pl, mode="serial",
+                              fallback="single-chunk")
+    else:
+        assert pip_pl["mode"] == "pipelined"
+        assert pip_pl["fallback"] is None
+    # Rejection attribution survived chunking + pipelining.
+    assert piped.metrics[0].rejected_eval_proof == 1
+    assert piped.metrics[0].rejected_weight_check == 1
+
+    if num_chunks == 3:
+        # Satellite: tail-chunk rate honesty — the tail computes
+        # chunk_size padded lanes but holds only 2 live reports, so
+        # the live rate must be stamped alongside the padded one.
+        for run in (serial, piped):
+            chunks = run.metrics[-1].extra["chunks"]
+            tail = chunks[-1]
+            assert tail["reports"] == 2
+            assert tail["node_evals_per_sec"] == pytest.approx(
+                tail["node_evals_per_sec_padded"] * 2 / chunk_size,
+                rel=0.01)
+            full = chunks[0]
+            assert full["node_evals_per_sec"] == \
+                full["node_evals_per_sec_padded"]
+
+
+@pytest.mark.slow
+def test_level0_agg_shares_identical(monkeypatch) -> None:
+    """The round's RETURNED aggregate (unsharded from the accumulated
+    agg shares) is identical serial vs pipelined — the direct
+    agg-share probe on top of the carried-state identity above."""
+    m = MasticCount(3)
+    reports = _tampered_reports(m)
+    vk = gen_rand(m.VERIFY_KEY_SIZE)
+    param = (0, ((False,), (True,)), True)
+
+    def level0(lever):
+        monkeypatch.setenv("MASTIC_PIPELINE", lever)
+        run = HeavyHittersRun(m, CTX, {"default": 2}, reports,
+                              verify_key=vk, chunk_size=4)
+        return run.runner.round(param)
+
+    assert level0("0") == level0("1")
+
+
+# -- measured overlap: injected store latency ------------------------
+
+
+def test_overlap_timeline_under_store_latency(monkeypatch) -> None:
+    """With injected store latency, the pipelined round stages chunk
+    i+1 while chunk i's dispatched work is still in flight (its
+    collect has not begun): upload overlaps compute.  Serial mode
+    shows strict ordering.  Either way each chunk pays exactly one
+    blocking host sync."""
+    m = MasticCount(3)
+    reports = _tampered_reports(m)
+    vk = gen_rand(m.VERIFY_KEY_SIZE)
+
+    real_slice = HostReportStore.host_slice
+
+    def slow_slice(self, x, i):
+        time.sleep(0.004)  # ~10 arrays/chunk -> ~40ms staging
+        return real_slice(self, x, i)
+
+    def one_round(lever):
+        monkeypatch.setenv("MASTIC_PIPELINE", lever)
+        run = HeavyHittersRun(m, CTX, {"default": 2}, reports,
+                              verify_key=vk, chunk_size=4)
+        monkeypatch.setattr(HostReportStore, "host_slice", slow_slice)
+        run.step()
+        monkeypatch.setattr(HostReportStore, "host_slice", real_slice)
+        return run.metrics[0].extra
+
+    piped = one_round("1")
+    tl = piped["chunks"]
+    assert piped["pipeline"]["mode"] == "pipelined"
+    for i in range(len(tl) - 1):
+        # Chunk i+1's staging began (and finished) before chunk i's
+        # collect — i.e. while chunk i's async-dispatched round was
+        # still computing.
+        assert tl[i + 1]["stage_start_ms"] < tl[i]["collect_start_ms"]
+        assert tl[i + 1]["stage_end_ms"] <= tl[i]["collect_end_ms"]
+    assert all(rec["host_syncs"] == 1 for rec in tl)
+    phases = tl[0]["phases"]
+    assert set(phases) >= {"upload_ms", "dispatch_ms", "compile_ms",
+                           "compute_wait_ms", "download_ms",
+                           "host_ms"}
+    assert phases["upload_ms"] >= 20.0  # the injected latency landed
+
+    serial = one_round("0")
+    tl = serial["chunks"]
+    assert serial["pipeline"]["mode"] == "serial"
+    for i in range(len(tl) - 1):
+        assert tl[i + 1]["stage_start_ms"] >= tl[i]["collect_end_ms"]
+    assert all(rec["host_syncs"] == 1 for rec in tl)
+
+
+# -- ahead-of-time bucket compilation --------------------------------
+
+
+def test_aot_predicted_buckets_compile_free(monkeypatch) -> None:
+    """Steady-state frontier: after the first round, every round's
+    programs were compiled ahead of time from the predicted frontier
+    trajectory (while the previous round's device work was in
+    flight) — zero inline compile wait, measured via the timeline's
+    compile field on a cold per-runner cache."""
+    monkeypatch.setenv("MASTIC_PIPELINE", "1")
+    m = MasticCount(3)
+    run = HeavyHittersRun(m, CTX, {"default": 2}, _clean_reports(m),
+                          verify_key=gen_rand(m.VERIFY_KEY_SIZE),
+                          chunk_size=4)
+    _run_all(run)
+    assert sorted(run.result()) == sorted(
+        [m.vidpf.test_index_from_int(v, 3) for v in (0, 7)])
+
+    first = run.metrics[0].extra["pipeline"]
+    assert first["compile_inline_ms"] > 0.0  # cold start pays once
+    for mx in run.metrics[1:]:
+        pl = mx.extra["pipeline"]
+        assert pl["aot"]["predicted"], f"level {mx.level} unpredicted"
+        assert pl["compile_inline_ms"] == 0.0, \
+            f"level {mx.level} paid inline compile"
+        assert pl["aot"]["compile_wait_ms"] == 0.0
+    stats = run.runner.programs.stats
+    assert stats["warm_compiles"] > 0
+    assert stats["warm_errors"] == 0
+    # The predictor warmed at most its two candidates per round.
+    assert stats["inline_compiles"] + stats["warm_compiles"] <= \
+        2 + 4 * len(run.metrics)
+
+
+@pytest.mark.slow
+def test_aot_mispredict_compiles_inline_correctly(
+        monkeypatch) -> None:
+    """A frontier that outgrows the padded width breaks the
+    prediction (grow candidates are skipped by design): the grow
+    round pays its compile inline and still produces the correct
+    result — byte-equal to the serial reference."""
+    m = MasticCount(5)
+    meas = [(m.vidpf.test_index_from_int(v * 4, 5), True)
+            for v in range(8)]
+    reports = get_reports_from_measurements(m, CTX, meas)
+    vk = gen_rand(m.VERIFY_KEY_SIZE)
+
+    def full_run(lever):
+        monkeypatch.setenv("MASTIC_PIPELINE", lever)
+        run = HeavyHittersRun(m, CTX, {"default": 1}, reports,
+                              verify_key=vk, chunk_size=4)
+        _run_all(run)
+        return run
+
+    piped = full_run("1")
+    assert piped.runner.width == 16  # the growth happened
+    grow_round = piped.metrics[3].extra["pipeline"]
+    assert not grow_round["aot"]["predicted"]
+    assert grow_round["compile_inline_ms"] > 0.0
+    assert sorted(piped.result()) == sorted(
+        m.vidpf.test_index_from_int(v * 4, 5) for v in range(8))
+
+    serial = full_run("0")
+    assert _counters(serial.metrics) == _counters(piped.metrics)
+    _assert_state_equal(serial.to_bytes(), piped.to_bytes())
+
+
+# -- envelope honesty + budget fallback ------------------------------
+
+
+def test_envelope_pipeline_residency_fields() -> None:
+    m = MasticCount(3)
+    bm = BatchedMastic(m)
+    reports = _clean_reports(m)
+    run = HeavyHittersRun(m, CTX, {"default": 2}, reports,
+                          verify_key=gen_rand(m.VERIFY_KEY_SIZE),
+                          chunk_size=4)
+    env = memory_envelope(bm, 4, run.runner.width, len(reports))
+    mem = run.runner.memory_accounting()
+    # The serial parity (locked in test_chunked) extends to the
+    # pipelined term: exactly two chunks' resident state, plus one
+    # chunk's worst-case binder staging.
+    assert env["pipeline_chunks_in_flight"] == \
+        PIPELINE_CHUNKS_IN_FLIGHT == 2
+    assert env["device_bytes_per_chunk"] == \
+        mem["device_bytes_per_chunk"]
+    assert env["device_bytes_per_chunk_pipelined"] == \
+        2 * mem["device_bytes_per_chunk"]
+    assert env["device_peak_bytes_per_chunk_pipelined"] == \
+        (2 * env["device_bytes_per_chunk"]
+         + env["device_peak_bytes_per_chunk"]
+         - env["device_bytes_per_chunk"])
+    assert 0 < env["max_pipelined_chunk_size_at_width"] \
+        <= env["max_chunk_size_at_width"] // 2 + 1
+    # The shared cost model prices N chunks in flight linearly in the
+    # resident term and once in staging.
+    one = round_peak_bytes(bm, 2, 1, 4, 1000)
+    two = round_peak_bytes(bm, 2, 1, 4, 1000, chunks_in_flight=2)
+    assert two - one == 1000
+
+
+@pytest.mark.slow
+def test_budget_fallback_to_serial(monkeypatch) -> None:
+    """A budget that admits one chunk in flight but not two: the
+    executor degrades to serial, NAMES the fallback in metrics, and
+    the run stays correct."""
+    monkeypatch.setenv("MASTIC_PIPELINE", "1")
+    m = MasticCount(3)
+    bm = BatchedMastic(m)
+    reports = _clean_reports(m)
+    run = HeavyHittersRun(m, CTX, {"default": 2}, reports,
+                          verify_key=gen_rand(m.VERIFY_KEY_SIZE),
+                          chunk_size=4)
+    resident = run.runner.memory_accounting()["device_bytes_per_chunk"]
+    # Level-0 buckets: onehot 2, payload 1 (no internal nodes yet).
+    serial_peak = round_peak_bytes(bm, 2, 1, 4, resident)
+    pipe_peak = round_peak_bytes(bm, 2, 1, 4, resident,
+                                 chunks_in_flight=2)
+    assert pipe_peak > serial_peak
+    monkeypatch.setenv("MASTIC_DEVICE_BUDGET_BYTES",
+                       str((serial_peak + pipe_peak) // 2))
+    run.step()
+    pl = run.metrics[0].extra["pipeline"]
+    assert pl["mode"] == "serial"
+    assert pl["fallback"] == "device-budget"
+    monkeypatch.delenv("MASTIC_DEVICE_BUDGET_BYTES")
+    _run_all(run)
+    assert run.metrics[1].extra["pipeline"]["mode"] == "pipelined"
+    assert sorted(run.result()) == sorted(
+        [m.vidpf.test_index_from_int(v, 3) for v in (0, 7)])
+
+
+# -- program-cache shape keying: grow then weight check --------------
+
+
+@pytest.mark.slow
+def test_grow_then_weight_check(monkeypatch) -> None:
+    """Round programs are keyed by the shapes they close over, so a
+    width growth BEFORE a weight-check round (the attribute-metrics
+    shape: one weight-checked aggregation at an arbitrary level, or a
+    checkpoint restored at a grown width) runs correctly — the
+    r5..r8 `_grow` cleared `_eval_fn`/`_agg_fn` but not `_wc_fns`,
+    which was only safe because the wc program's input shapes are
+    width-independent.  Locked here: grow to width 16, then run the
+    weight-check round and the rest of the collection bit-identically
+    to the ungrown reference."""
+    monkeypatch.setenv("MASTIC_PIPELINE", "1")
+    m = MasticCount(3)
+    reports = _tampered_reports(m)
+    vk = gen_rand(m.VERIFY_KEY_SIZE)
+
+    ref = HeavyHittersRun(m, CTX, {"default": 2}, reports,
+                          verify_key=vk, chunk_size=4)
+    _run_all(ref)
+
+    grown = HeavyHittersRun(m, CTX, {"default": 2}, reports,
+                            verify_key=vk, chunk_size=4)
+    grown.runner._grow(16)
+    assert grown.runner.width == 16
+    _run_all(grown)
+
+    assert grown.result() == ref.result()
+    for (a, b) in zip(ref.metrics, grown.metrics):
+        assert (a.accepted, a.rejected_eval_proof,
+                a.rejected_weight_check, a.rejected_joint_rand) == \
+            (b.accepted, b.rejected_eval_proof,
+             b.rejected_weight_check, b.rejected_joint_rand)
+    # The weight check fired at the grown width and still attributed.
+    assert grown.metrics[0].rejected_weight_check == 1
+    assert grown.metrics[0].padded_width == 16
+    # Every compiled program key carries the width it closed over.
+    eval_keys = [k for k in grown.runner.programs._programs
+                 if k[0] == "eval"]
+    assert eval_keys and all(k[2] == 16 for k in eval_keys)
+
+
+# -- composition: checkpoint kill-resume with faults armed -----------
+
+
+@pytest.mark.slow
+def test_kill_resume_pipelined_with_faults_armed(monkeypatch) -> None:
+    """A pipelined run killed after a checkpoint resumes (PR 3-style
+    snapshot/replay) bit-identically to an uninterrupted run, with
+    the `MASTIC_FAULTS` lever armed throughout — pipelining composes
+    with the fault-injection machinery instead of fighting it (the
+    chunked runner is in-process, so the session-layer rules are
+    inert here; the slow tier runs the process-separated session
+    under the pipeline lever)."""
+    monkeypatch.setenv("MASTIC_PIPELINE", "1")
+    monkeypatch.setenv("MASTIC_FAULTS",
+                       "kill:party=helper:step=round_start")
+    m = MasticCount(3)
+    reports = _tampered_reports(m)
+    vk = gen_rand(m.VERIFY_KEY_SIZE)
+    thresholds = {"default": 2}
+
+    ref = HeavyHittersRun(m, CTX, thresholds, reports, verify_key=vk,
+                          chunk_size=4)
+    _run_all(ref)
+
+    victim = HeavyHittersRun(m, CTX, thresholds, reports,
+                             verify_key=vk, chunk_size=4)
+    victim.step()
+    blob = victim.to_bytes()
+    del victim  # the "kill": only the snapshot survives
+
+    resumed = HeavyHittersRun.from_bytes(m, CTX, thresholds, reports,
+                                         vk, blob)
+    assert resumed.level == 1
+    assert resumed.runner.store.num_chunks == 3
+    _run_all(resumed)
+    assert resumed.result() == ref.result()
+    _assert_state_equal(ref.to_bytes(), resumed.to_bytes())
+
+
+# -- resident runner: deferred-sync round ----------------------------
+
+
+@pytest.mark.slow
+def test_resident_deferred_round_timeline(monkeypatch) -> None:
+    monkeypatch.setenv("MASTIC_PIPELINE", "1")
+    m = MasticCount(3)
+    reports = _tampered_reports(m)
+    run = HeavyHittersRun(m, CTX, {"default": 2}, reports,
+                          verify_key=gen_rand(m.VERIFY_KEY_SIZE))
+    _run_all(run)
+    assert sorted(run.result()) == sorted(
+        m.vidpf.test_index_from_int(v, 3) for v in (0, 5, 6))
+    assert run.metrics[0].rejected_eval_proof == 1
+    assert run.metrics[0].rejected_weight_check == 1
+    for mx in run.metrics:
+        pl = mx.extra["pipeline"]
+        assert pl["mode"] == "resident-deferred"
+        assert pl["host_syncs"] == 1
+        assert set(pl["phases"]) == {"upload_ms", "compile_ms",
+                                     "dispatch_ms", "warm_ms",
+                                     "compute_wait_ms",
+                                     "download_ms", "host_ms"}
+    # AOT warming applies to the resident loop too.
+    assert run.runner.programs.stats["warm_compiles"] > 0
+
+
+# -- the from-root chunked attribute round ---------------------------
+
+
+@pytest.mark.slow
+def test_attribute_round_chunked_pipelined(monkeypatch) -> None:
+    from mastic_tpu.drivers.attribute_metrics import (
+        aggregate_by_attribute)
+
+    m = MasticCount(8)
+    attrs = ["checkout", "landing"]  # hash-distinct at BITS=8
+    from mastic_tpu.drivers.attribute_metrics import hash_attribute
+    meas = [(hash_attribute(m, "checkout"), True)] * 3 + \
+        [(hash_attribute(m, "landing"), True)]
+    reports = get_reports_from_measurements(m, CTX, meas)
+    vk = gen_rand(m.VERIFY_KEY_SIZE)
+
+    whole = aggregate_by_attribute(m, CTX, attrs, reports,
+                                   verify_key=vk)
+    monkeypatch.setenv("MASTIC_PIPELINE", "1")
+    out_p: list = []
+    piped = aggregate_by_attribute(m, CTX, attrs, reports,
+                                   verify_key=vk, chunk_size=2,
+                                   metrics_out=out_p)
+    monkeypatch.setenv("MASTIC_PIPELINE", "0")
+    out_s: list = []
+    serial = aggregate_by_attribute(m, CTX, attrs, reports,
+                                    verify_key=vk, chunk_size=2,
+                                    metrics_out=out_s)
+    assert whole == piped == serial == \
+        [("checkout", 3), ("landing", 1)]
+    assert out_p[0].extra["pipeline"]["mode"] == "pipelined"
+    assert out_s[0].extra["pipeline"]["mode"] == "serial"
+    tl = out_p[0].extra["chunks"]
+    assert len(tl) == 2 and all(r["host_syncs"] == 1 for r in tl)
+    assert tl[1]["stage_start_ms"] < tl[0]["collect_start_ms"]
+
+
+# -- slow tier: the process-separated session under the lever --------
+
+
+@pytest.mark.slow
+def test_session_kill_resume_under_pipeline_lever(monkeypatch):
+    """PR 3's headline kill-and-resume (process-separated parties,
+    respawn + replay) runs bit-identically with the pipeline lever
+    pinned on — the env var reaches the spawned parties, proving the
+    two levers compose end to end."""
+    from mastic_tpu.drivers.parties import AggregationSession
+    from mastic_tpu.drivers.session import SessionConfig
+
+    monkeypatch.setenv("MASTIC_PIPELINE", "1")
+    cfg = SessionConfig(connect_timeout=30.0, exchange_timeout=240.0,
+                        ack_timeout=60.0, round_deadline=600.0,
+                        shutdown_timeout=5.0, retries=1, backoff=0.2)
+    m = MasticCount(2)
+    vk = gen_rand(m.VERIFY_KEY_SIZE)
+    spec = {"class": "MasticCount", "args": [2]}
+    param = (0, ((False,), (True,)), True)
+    reports = get_reports_from_measurements(
+        m, CTX, [((False, True), True), ((True, False), True)])
+
+    sess0 = AggregationSession(m, spec, CTX, vk, config=cfg)
+    try:
+        sess0.upload(reports)
+        (r0, a0, s0) = sess0.round(param)
+    finally:
+        sess0.close()
+
+    sess1 = AggregationSession(
+        m, spec, CTX, vk, config=cfg,
+        faults_spec="kill:party=helper:step=round_start")
+    try:
+        sess1.upload(reports)
+        (r1, a1, s1) = sess1.round(param)
+    finally:
+        sess1.close()
+    assert sess1.counters["respawns"] == 1
+    assert (r1, list(a1), s1) == (r0, list(a0), s0)

@@ -60,6 +60,14 @@ run aes-pallas python bench.py --headline-only --aes-pallas
 # vs the scan path's measured 15.8 KB).
 run level-pallas python bench.py --headline-only --level-pallas
 
+# 4. Pipelined chunk-streaming executor (drivers/pipeline.py): the
+# chunked PRODUCTION round with MASTIC_PIPELINE on vs off, so the
+# overlap + ahead-of-time-compile gain is measured unattended the
+# moment the tunnel returns.  The JSON lines carry the per-phase
+# timeline and overlap_efficiency (never touch BENCH_LAST_GOOD).
+run pipeline-on python bench.py --chunked-round-only --pipeline on
+run pipeline-off python bench.py --chunked-round-only --pipeline off
+
 # Every on-chip run persists itself to BENCH_LAST_GOOD; end on the
 # default configuration so the cached record reflects the default
 # levers, not whichever matrix cell happened to run last.

@@ -234,10 +234,6 @@ def main() -> None:
     requested = os.environ.get("JAX_PLATFORMS", "").strip()
     if requested and "axon" not in requested.split(","):
         jax.config.update("jax_platforms", requested)
-    jax.config.update("jax_compilation_cache_dir",
-                      "/tmp/mastic_tpu_jax_cache")
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
     from mastic_tpu import MasticCount, MasticSum
     from mastic_tpu.backend.mastic_jax import BatchedMastic
@@ -253,6 +249,23 @@ def main() -> None:
     bm = BatchedMastic(m)
     rng = np.random.default_rng(args.seed)
     platform = jax.devices()[0].platform
+    # Persistent XLA compile cache: a proven win on chip, but on the
+    # CPU fabric RELOADING cached executables is unsound — the second
+    # process on a warm cache segfaults or, worse, loads a silently
+    # wrong program that rejects every report (r9 measured this at
+    # the pre-pipeline HEAD too, so it is a fabric landmine, not a
+    # pipeline regression; PERF.md §7).  The wiring is therefore
+    # platform-gated; MASTIC_COMPILE_CACHE=1 forces it on anywhere,
+    # =0 forces it off anywhere.
+    cache_lever = os.environ.get("MASTIC_COMPILE_CACHE", "")
+    if cache_lever == "1" or (cache_lever != "0"
+                              and platform != "cpu"):
+        jax.config.update("jax_compilation_cache_dir",
+                          "/tmp/mastic_tpu_jax_cache")
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                          -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.0)
     if args.mesh and args.mesh > jax.device_count():
         print(f"--mesh {args.mesh} exceeds the {jax.device_count()} "
               f"available {platform} device(s)", file=sys.stderr)
@@ -432,6 +445,25 @@ def main() -> None:
     expected = {tuple(bool(b) for b in row) for row in paths}
     got = set(hitters)
     mem = run.runner.memory_accounting()
+    # Pipelined-executor summary (drivers/pipeline.py): overlap
+    # efficiency is a measured number in the artifact, and a
+    # degrade-to-serial fallback is named, never silent.
+    pipe_rounds = [mx.extra["pipeline"] for mx in run.metrics
+                   if "pipeline" in mx.extra]
+    pipeline_out = None
+    if pipe_rounds:
+        effs = sorted(p["overlap_efficiency"] for p in pipe_rounds)
+        pipeline_out = {
+            "mode": pipe_rounds[-1]["mode"],
+            "rounds_pipelined": sum(
+                p["mode"] == "pipelined" for p in pipe_rounds),
+            "rounds_total": len(pipe_rounds),
+            "overlap_efficiency_p50": effs[len(effs) // 2],
+            "compile_inline_ms_total": round(
+                sum(p["compile_inline_ms"] for p in pipe_rounds), 1),
+            "fallbacks": sorted({p["fallback"] for p in pipe_rounds
+                                 if p["fallback"]}),
+        }
     # Envelope at the FINAL width — a frontier that forced _grow must
     # be reflected next to the measured accounting.  Resident mode's
     # "chunk" is the entire batch.
@@ -458,6 +490,8 @@ def main() -> None:
         "heavy_hitters_expected": len(expected),
         "ok": got == expected,
     }
+    if pipeline_out is not None:
+        out["pipeline"] = pipeline_out
     if args.inst == "sum":
         out["max_weight"] = args.max_weight
     if resumed_from is not None:
