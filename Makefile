@@ -18,22 +18,33 @@
 #                (drivers/pipeline.py: serial bit-identity, overlap
 #                timeline, AOT bucket compile, budget fallback) —
 #                fast tier only
+#   make multichip  mesh-sharded round suite (fast tier of
+#                tests/test_mesh_pipeline.py: envelope/padding/key
+#                units + per-device allocation parity) plus the REAL
+#                pipelined 8-device proof run (tools/multichip.py,
+#                virtual CPU devices: mesh=8 bit-identical to serial,
+#                zero inline compile after round 0)
 #   make test    full suite (adds the slow differential/adversarial/
 #                driver tiers)
 #   make bench   single-chip benchmark (prints one JSON line)
 
 PY ?= python
 
-.PHONY: ci lint analyze faults pipeline typecheck test-fast test \
-	test-slow test-slow-1 test-slow-2 bench
+.PHONY: ci lint analyze faults pipeline multichip typecheck \
+	test-fast test test-slow test-slow-1 test-slow-2 test-slow-3 \
+	bench
 
-ci: lint analyze faults pipeline typecheck test-fast
+ci: lint analyze faults pipeline multichip typecheck test-fast
 
 faults:
 	$(PY) -m pytest tests/test_faults.py -q -m "not slow"
 
 pipeline:
 	$(PY) -m pytest tests/test_pipeline.py -q -m "not slow"
+
+multichip:
+	$(PY) -m pytest tests/test_mesh_pipeline.py -q -m "not slow"
+	JAX_PLATFORMS=cpu $(PY) tools/multichip.py
 
 lint:
 	$(PY) tools/lint.py
@@ -50,13 +61,14 @@ typecheck:
 		     "scalar layer) - skipping"; \
 	fi
 
-# test_faults' / test_pipeline's fast tiers already ran as their own
-# gates right after analyze — skip them here so `make ci` doesn't pay
-# for them twice.
+# test_faults' / test_pipeline's / test_mesh_pipeline's fast tiers
+# already ran as their own gates right after analyze — skip them here
+# so `make ci` doesn't pay for them twice.
 test-fast:
 	$(PY) -m pytest tests/ -q -m "not slow" \
 		--ignore=tests/test_faults.py \
-		--ignore=tests/test_pipeline.py
+		--ignore=tests/test_pipeline.py \
+		--ignore=tests/test_mesh_pipeline.py
 
 test-slow:
 	$(PY) -m pytest tests/ -q -m "slow"
@@ -73,9 +85,16 @@ SLOW_SHARD_1 = tests/test_drivers.py tests/test_incremental.py \
 test-slow-1:
 	$(PY) -m pytest $(SLOW_SHARD_1) -q -m "slow"
 
+# The mesh bit-identity matrix is its own shard: every case is a pair
+# of full collection runs (~25 min cold total), which would blow
+# either existing shard past the 60-min job timeout.
+SLOW_SHARD_3 = tests/test_mesh_pipeline.py
 test-slow-2:
 	$(PY) -m pytest tests/ -q -m "slow" \
-		$(foreach f,$(SLOW_SHARD_1),--ignore=$(f))
+		$(foreach f,$(SLOW_SHARD_1) $(SLOW_SHARD_3),--ignore=$(f))
+
+test-slow-3:
+	$(PY) -m pytest $(SLOW_SHARD_3) -q -m "slow"
 
 test:
 	$(PY) -m pytest tests/ -q

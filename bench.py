@@ -421,11 +421,16 @@ def bench_full_round(bm, num_reports: int, agg_param, steps: int,
 
 
 def bench_incremental_round(bm, num_reports: int, frontier: int,
-                            bits: int, steps: int):
+                            bits: int, steps: int, mesh=None):
     """Steady-state *incremental* round at a deep level: tree step for
     both aggregators + binder hashing over the carried ancestor tree +
     eval proof + masked aggregation (backend/incremental.py).  Carry
-    contents are random — cost is input-independent."""
+    contents are random — cost is input-independent.
+
+    With `mesh`, carries / batch / round keys place report-sharded and
+    the masked aggregate's psum is the only cross-chip collective —
+    the returned dict then carries the per-shard rate and the psum
+    bytes per round next to the aggregate rate."""
     import time as _time
 
     import jax
@@ -474,30 +479,68 @@ def bench_incremental_round(bm, num_reports: int, frontier: int,
     (ext_rk, conv_rk) = jax.jit(
         lambda nn: bm.vidpf.roundkeys(b"bench", nn))(batch.nonces)
 
+    cws = batch.cws
+    jit_kwargs = {}
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from mastic_tpu.parallel import place_replicated, place_reports
+
+        (ext_rk, conv_rk, cws) = place_reports(
+            mesh, (ext_rk, conv_rk, cws))
+        rnd = place_replicated(mesh, rnd)
+        rep = NamedSharding(mesh, P("reports"))
+        repl = NamedSharding(mesh, P())
+        # Carries report-sharded in and out; aggregates replicated —
+        # the psum over the sharded report axis is the round's only
+        # collective (PERF.md §8's cost model).
+        jit_kwargs["out_shardings"] = (rep, rep, repl, repl)
+
+    def place(c):
+        if mesh is None:
+            return c
+        from mastic_tpu.parallel import place_reports
+        return place_reports(mesh, c)
+
     def both(c0, c1, r):
         (c0, p0, out0, ok0) = engine.agg_round(
-            0, vk, b"bench", c0, r, ext_rk, conv_rk, batch.cws)
+            0, vk, b"bench", c0, r, ext_rk, conv_rk, cws)
         (c1, p1, out1, ok1) = engine.agg_round(
-            1, vk, b"bench", c1, r, ext_rk, conv_rk, batch.cws)
+            1, vk, b"bench", c1, r, ext_rk, conv_rk, cws)
         accept = jnp.all(p0 == p1, axis=-1)
         return (c0, c1, bm.aggregate(out0, accept),
                 bm.aggregate(out1, accept))
 
-    fn = jax.jit(both, donate_argnums=(0, 1))
+    fn = jax.jit(both, donate_argnums=(0, 1), **jit_kwargs)
     t0 = _time.perf_counter()
-    compiled = fn.lower(carry(), carry(), rnd).compile()
+    compiled = fn.lower(place(carry()), place(carry()), rnd).compile()
     compile_s = _time.perf_counter() - t0
-    (c0, c1) = (carry(), carry())
-    (c0, c1, a0, _a1) = compiled(c0, c1, rnd)
+    (c0, c1) = (place(carry()), place(carry()))
+    (c0, c1, a0, a1) = compiled(c0, c1, rnd)
     jax.block_until_ready(a0)
 
     t0 = _time.perf_counter()
     for _ in range(steps):
-        (c0, c1, a0, _a1) = compiled(c0, c1, rnd)
+        (c0, c1, a0, a1) = compiled(c0, c1, rnd)
     jax.block_until_ready(a0)
     per_round = (_time.perf_counter() - t0) / steps
     evals = num_reports * 2 * num_parents * 2  # both aggregators
-    return (per_round, evals / per_round, compile_s)
+    collective_bytes = (a0.nbytes + a1.nbytes if mesh is not None
+                        else 0)
+    return (per_round, evals / per_round, compile_s,
+            collective_bytes)
+
+
+def _bench_mesh(args):
+    """The --mesh lever resolved to a Mesh (None when off).  `mesh_n`
+    is resolved after the jax import in main ("all" -> device count).
+    """
+    n = getattr(args, "mesh_n", 1)
+    if n <= 1:
+        return None
+    from mastic_tpu.parallel import make_mesh
+
+    return make_mesh(n, nodes_axis=1)
 
 
 def bench_chunked_round(args) -> dict:
@@ -539,9 +582,10 @@ def bench_chunked_round(args) -> dict:
                                  dtype=np.uint8)))
     assert bool(np.all(np.asarray(ok)))
     store = HostReportStore.from_batch(batch, C)
+    mesh = _bench_mesh(args)
     run = HeavyHittersRun(m, b"bench", {"default": R // 6}, None,
                           verify_key=gen_rand(m.VERIFY_KEY_SIZE),
-                          store=store)
+                          store=store, mesh=mesh)
     t0 = time.perf_counter()
     while run.step():
         pass
@@ -556,9 +600,29 @@ def bench_chunked_round(args) -> dict:
             for (k, v) in rec["phases"].items():
                 phases[k] = phases.get(k, 0.0) + v
     evals = sum(mx.node_evals for mx in run.metrics)
+    shards = mesh.shape["reports"] if mesh is not None else 1
+    mesh_block = None
+    if mesh is not None:
+        rounds_m = [mx.extra["mesh"] for mx in run.metrics
+                    if "mesh" in mx.extra]
+        skews = sorted(mr["shard_wait_skew_ms_max"] for mr in rounds_m)
+        mesh_block = {
+            "report_shards": shards,
+            "device_rows_per_chunk":
+                rounds_m[-1]["device_rows_per_chunk"],
+            "psum_bytes_per_round_last":
+                rounds_m[-1]["psum_bytes_per_round"],
+            "psum_bytes_total": sum(mr["psum_bytes_per_round"]
+                                    for mr in rounds_m),
+            "shard_wait_skew_ms_p50": skews[len(skews) // 2],
+            "shard_wait_skew_ms_max": skews[-1],
+        }
     return {
         "instance": f"MasticCount({bits})",
         "reports": R, "chunk_size": C, "levels": len(run.metrics),
+        "mesh_devices": shards,
+        "mesh": mesh_block,
+        "node_evals_per_sec_per_shard": round(evals / wall / shards, 1),
         "pipeline": pipes[-1]["mode"],
         "fallbacks": sorted({p["fallback"] for p in pipes
                              if p["fallback"]}),
@@ -586,16 +650,30 @@ def run_configs(args) -> dict:
 
     configs = PARTIAL.setdefault("configs", {})
 
-    # 1. Full steady-state incremental round at the headline shape.
-    stamp("config-incremental-round")
+    # 1. Full steady-state incremental round at the headline shape,
+    # mesh-sharded over the report axis when --mesh asks for it (the
+    # per-shard rate + psum bytes are the 8-chip scaling stamps).
+    stamp("config-incremental-round", mesh=getattr(args, "mesh_n", 1))
+    mesh = _bench_mesh(args)
     bm = BatchedMastic(MasticCount(args.bits))
-    (per_round, evals_s, compile_s) = bench_incremental_round(
-        bm, args.reports // 2, args.frontier, args.bits, args.steps)
+    reports = args.reports // 2
+    if mesh is not None:
+        n = mesh.shape["reports"]
+        reports = -(-reports // n) * n  # resident tile shards evenly
+    (per_round, evals_s, compile_s, coll_bytes) = \
+        bench_incremental_round(bm, reports, args.frontier, args.bits,
+                                args.steps, mesh=mesh)
     configs["incremental_round"] = {
         "instance": f"MasticCount({args.bits})",
-        "reports": args.reports // 2, "frontier": args.frontier,
+        "reports": reports, "frontier": args.frontier,
+        "mesh_devices": (mesh.shape["reports"]
+                         if mesh is not None else 1),
         "round_ms": round(per_round * 1e3, 2),
         "node_evals_per_sec": round(evals_s, 1),
+        "node_evals_per_sec_per_shard": round(
+            evals_s / (mesh.shape["reports"] if mesh is not None
+                       else 1), 1),
+        "collective_bytes_per_round": coll_bytes,
         "compile_seconds": round(compile_s, 1),
     }
     stamp("config-incremental-done", evals_s=f"{evals_s:.0f}")
@@ -687,6 +765,13 @@ def main():
     parser.add_argument("--chunked-reports", type=int, default=1024,
                         help="report count for the chunked-round "
                         "config (4 chunks)")
+    parser.add_argument("--mesh", type=str, default="1",
+                        help="shard the report axis of the "
+                        "incremental_round and chunked_round configs "
+                        "over this many devices ('all' = every "
+                        "attached device; 1 = off).  On CPU a numeric "
+                        "value forces that many virtual host devices "
+                        "(xla_force_host_platform_device_count)")
     parser.add_argument("--watchdog", type=float, default=1500.0)
     parser.add_argument("--attach-timeout", type=float, default=60.0)
     parser.add_argument("--attach-retries", type=int, default=3)
@@ -721,6 +806,17 @@ def main():
     if cached is not None:
         stamp("cache-seeded", value=cached["value"],
               rev=cached.get("git_rev", "?")[:12])
+
+    # A numeric --mesh > 1 must pin the virtual host device count
+    # BEFORE the jax import (jax snapshots XLA_FLAGS then); on a chip
+    # platform the flag only affects the unused host backend, so it is
+    # always safe to set.  "all" resolves after the import.
+    if args.mesh not in ("all",) and int(args.mesh) > 1:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{int(args.mesh)}").strip()
 
     stamp("import-jax")
     if args.cpu:
@@ -760,6 +856,16 @@ def main():
     devices = jax.devices()
     stamp("device-up", devices=devices)
     on_chip = devices[0].platform != "cpu"
+    # Resolve the --mesh lever now that the device set is known.
+    args.mesh_n = (len(devices) if args.mesh == "all"
+                   else int(args.mesh))
+    if args.mesh_n > len(devices):
+        timer.cancel()
+        emit(error=f"--mesh {args.mesh_n} exceeds the "
+             f"{len(devices)} attached device(s)")
+        sys.exit(2)
+    if args.mesh_n > 1:
+        PARTIAL["mesh_devices"] = args.mesh_n
     # Stamped into every emit from here on, so a CPU-sim rate can
     # never be mistaken for a chip rate in a round artifact.
     PARTIAL["platform"] = devices[0].platform

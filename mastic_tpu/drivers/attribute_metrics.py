@@ -32,7 +32,8 @@ def aggregate_by_attribute(mastic: Mastic, ctx: bytes,
                            attributes: Sequence[str], reports: list,
                            verify_key: Optional[bytes] = None,
                            metrics_out: Optional[list] = None,
-                           chunk_size: Optional[int] = None) -> list:
+                           chunk_size: Optional[int] = None,
+                           mesh=None) -> list:
     """Aggregate `reports` grouped by the collector's attributes of
     interest.  Returns [(attribute, aggregate)] pairs; appends a
     RoundMetrics record to `metrics_out` (observability, SURVEY §5).
@@ -40,7 +41,14 @@ def aggregate_by_attribute(mastic: Mastic, ctx: bytes,
     With `chunk_size`, reports stream through the single aggregation
     round in fixed-size blocks (the device never holds the whole
     batch; full chunks share one compiled program, the tail runs at
-    its natural size), bit-identical to the unchunked result."""
+    its natural size), bit-identical to the unchunked result.
+
+    With `mesh`, each chunk's report axis shards across the mesh's
+    "reports" devices (padded to the shard multiple and masked when
+    uneven — same rule as the chunked heavy-hitters runner) and the
+    masked aggregation's psum is the round's only cross-chip
+    collective; bit-identical to the single-device result either way.
+    """
     if verify_key is None:
         verify_key = gen_rand(mastic.VERIFY_KEY_SIZE)
     bm = BatchedMastic(mastic)
@@ -52,20 +60,67 @@ def aggregate_by_attribute(mastic: Mastic, ctx: bytes,
     assert mastic.is_valid(agg_param, [])
     if chunk_size is not None and chunk_size < 1:
         raise ValueError(f"chunk_size must be >= 1, got {chunk_size}")
+    if chunk_size is None and mesh is not None:
+        # The mesh path needs the padded+masked chunk machinery for
+        # uneven report counts — stream as one chunk.
+        chunk_size = len(reports)
     if chunk_size is None:
         batch = bm.marshal_reports(reports)
         result = run_round(bm, verify_key, ctx, agg_param, batch,
                            reports, metrics_out=metrics_out)
     else:
         result = _run_round_chunked(bm, verify_key, ctx, agg_param,
-                                    reports, chunk_size, metrics_out)
+                                    reports, chunk_size, metrics_out,
+                                    mesh=mesh)
     return list(zip(attributes, result))
+
+
+def _round_fn_masked(bm: BatchedMastic, ctx: bytes, agg_param, mesh):
+    """The mesh twin of heavy_hitters._round_fn: a from-root round
+    program over a shard-padded batch with an explicit validity mask
+    (padded duplicate lanes must not reach the aggregate — the mask
+    folds into the aggregation the way the chunked runner's `valid`
+    does).  Jitted once per (ctx, agg_param, mesh shape); outputs pin
+    the aggregates replicated (the psum) and the verdict masks
+    report-sharded."""
+    import jax
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    cache = getattr(bm, "_round_masked_cache", None)
+    if cache is None:
+        cache = {}
+        bm._round_masked_cache = cache
+    key = (ctx, agg_param, mesh.shape["reports"])
+    fn = cache.get(key)
+    if fn is None:
+        (_level, _prefixes, do_weight_check) = agg_param
+
+        def body(vk, batch, valid):
+            (p0, p1) = bm.prep_both(vk, ctx, agg_param, batch)
+            checks = bm.accept_checks(p0, p1, do_weight_check)
+            accept = checks["eval_proof"]
+            for (name, mask) in checks.items():
+                if name != "eval_proof":
+                    accept = accept & mask
+            ok = p0.ok & p1.ok
+            agg0 = bm.aggregate(p0.out_share, accept & ok & valid)
+            agg1 = bm.aggregate(p1.out_share, accept & ok & valid)
+            return (agg0, agg1, accept, ok, checks)
+
+        repl = NamedSharding(mesh, P())
+        rep = NamedSharding(mesh, P("reports"))
+        fn = jax.jit(body,
+                     out_shardings=(repl, repl, rep, rep, rep))
+        cache[key] = fn
+    return fn
 
 
 def _run_round_chunked(bm: BatchedMastic, verify_key: bytes,
                        ctx: bytes, agg_param, reports: list,
                        chunk_size: int,
-                       metrics_out: Optional[list]) -> list:
+                       metrics_out: Optional[list],
+                       mesh=None) -> list:
     """One from-root aggregation round streamed chunk by chunk
     (heavy_hitters.run_round semantics, accumulated aggregates), on
     the pipelined executor: chunk i+1's scalar reports marshal (the
@@ -97,14 +152,38 @@ def _run_round_chunked(bm: BatchedMastic, verify_key: bytes,
     bounds = [(lo, min(lo + chunk_size, num))
               for lo in range(0, num, chunk_size)]
     vk_arr = _vk_array(verify_key)
-    fn = _round_fn(bm, ctx, agg_param)
+    shards = mesh.shape["reports"] if mesh is not None else 1
+    if mesh is not None:
+        from ..parallel.mesh import place_replicated, place_reports
+        vk_arr = place_replicated(mesh, vk_arr)
+        fn = _round_fn_masked(bm, ctx, agg_param, mesh)
+    else:
+        fn = _round_fn(bm, ctx, agg_param)
+    psum_bytes: list = [0]
+    shard_skews: list = []
 
     def stage(i: int):
         (lo, hi) = bounds[i]
         t0 = time.perf_counter()
-        batch = bm.marshal_reports(reports[lo:hi])
-        t_up = time.perf_counter()
-        out = fn(vk_arr, batch)
+        if mesh is not None:
+            # Pad the chunk's report list to the shard multiple (first
+            # report repeated) and mask: jax refuses uneven placement,
+            # and the masked aggregate excludes the duplicate lanes —
+            # bit-identical to the unpadded single-device sum.
+            rows = -(-(hi - lo) // shards) * shards
+            chunk = list(reports[lo:hi])
+            chunk += [reports[lo]] * (rows - len(chunk))
+            batch = bm.marshal_reports(chunk)
+            valid = np.zeros(rows, bool)
+            valid[:hi - lo] = True
+            (batch, valid_dev) = place_reports(
+                mesh, (batch, jax.numpy.asarray(valid)))
+            t_up = time.perf_counter()
+            out = fn(vk_arr, batch, valid_dev)
+        else:
+            batch = bm.marshal_reports(reports[lo:hi])
+            t_up = time.perf_counter()
+            out = fn(vk_arr, batch)
         t_d = time.perf_counter()
         phases = {
             "upload_ms": round((t_up - t0) * 1e3, 3),
@@ -117,19 +196,26 @@ def _run_round_chunked(bm: BatchedMastic, verify_key: bytes,
         (agg0, agg1, accept, ok, checks) = handle
         (lo, hi) = bounds[i]
         t0 = time.perf_counter()
+        if mesh is not None and shards > 1:
+            waits = []
+            for sh in accept.addressable_shards:
+                sh.data.block_until_ready()
+                waits.append((time.perf_counter() - t0) * 1e3)
+            shard_skews.append(round(max(waits) - min(waits), 3))
+            psum_bytes[0] += agg0.nbytes + agg1.nbytes
         jax.block_until_ready((agg0, agg1, accept, ok, checks))
         t_wait = time.perf_counter()
-        ok_all[lo:hi] = np.asarray(ok)
-        accept_all[lo:hi] = np.asarray(accept)
-        eval_ok[lo:hi] = np.asarray(checks["eval_proof"])
+        ok_all[lo:hi] = np.asarray(ok)[:hi - lo]
+        accept_all[lo:hi] = np.asarray(accept)[:hi - lo]
+        eval_ok[lo:hi] = np.asarray(checks["eval_proof"])[:hi - lo]
         if "weight_check" in checks:
             if wc_ok is None:
                 wc_ok = np.zeros(num, bool)
-            wc_ok[lo:hi] = np.asarray(checks["weight_check"])
+            wc_ok[lo:hi] = np.asarray(checks["weight_check"])[:hi - lo]
         if "joint_rand" in checks:
             if jr_ok is None:
                 jr_ok = np.zeros(num, bool)
-            jr_ok[lo:hi] = np.asarray(checks["joint_rand"])
+            jr_ok[lo:hi] = np.asarray(checks["joint_rand"])[:hi - lo]
         t_down = time.perf_counter()
         for (a, arr) in ((0, agg0), (1, agg1)):
             agg_shares[a] = vec_add(agg_shares[a],
@@ -156,18 +242,28 @@ def _run_round_chunked(bm: BatchedMastic, verify_key: bytes,
         checks["weight_check"] = wc_ok
     if jr_ok is not None:
         checks["joint_rand"] = jr_ok
+    extra = {"chunk_size": chunk_size,
+             "chunks": timeline,
+             "pipeline": {
+                 "mode": "pipelined" if pipelined else "serial",
+                 "fallback": (None if pipelined else
+                              ("single-chunk" if len(bounds) < 2
+                               else "lever-off")),
+                 "round_wall_ms": round(wall_ms, 2),
+                 "overlap_efficiency": overlap_efficiency(
+                     timeline, wall_ms),
+             }}
+    if mesh is not None:
+        skews = sorted(shard_skews)
+        extra["mesh"] = {
+            "report_shards": shards,
+            "psum_bytes_per_round": psum_bytes[0],
+            "shard_wait_skew_ms_p50":
+                (skews[len(skews) // 2] if skews else 0.0),
+            "shard_wait_skew_ms_max": (skews[-1] if skews else 0.0),
+        }
     return finalize_round(
         bm, verify_key, ctx, agg_param, reports, ok_all, accept_all,
         checks, agg_shares, padded_width=sched.total_nodes,
         nodes_evaluated=sched.total_nodes, metrics_out=metrics_out,
-        extra={"chunk_size": chunk_size,
-               "chunks": timeline,
-               "pipeline": {
-                   "mode": "pipelined" if pipelined else "serial",
-                   "fallback": (None if pipelined else
-                                ("single-chunk" if len(bounds) < 2
-                                 else "lever-off")),
-                   "round_wall_ms": round(wall_ms, 2),
-                   "overlap_efficiency": overlap_efficiency(
-                       timeline, wall_ms),
-               }})
+        extra=extra)

@@ -131,13 +131,25 @@ def _binder_staging_bytes(bm: BatchedMastic, onehot_cap: int,
 
 
 def memory_envelope(bm: BatchedMastic, chunk_size: int, width: int,
-                    num_reports: int) -> dict:
+                    num_reports: int,
+                    n_device_shards: int = 1) -> dict:
     """The (chunk_size, width) feasibility envelope: what one chunk
     costs the device and what the whole run costs the host, plus the
     largest chunk size that fits the device budget at this width.
-    PERF.md §4 walks the arithmetic at the 1M x 256 north star."""
+    PERF.md §4 walks the arithmetic at the 1M x 256 north star.
+
+    With `n_device_shards` > 1 the chunk's report axis is mesh-sharded
+    and every device-resident term divides by the shard count: the
+    `*_per_shard` fields price ONE chip's residency (the numbers the
+    per-device budget actually bounds; tests/test_mesh_pipeline.py
+    locks them against real per-device allocations).  Device rows pad
+    up to the shard multiple first (uneven chunks shard by padding +
+    masking, not by uneven placement — jax refuses the latter)."""
     per = per_report_bytes(bm, width)
     per_chunk = per["carry"] + per["roundkeys"] + per["store"]
+    shards = max(1, n_device_shards)
+    dev_rows = -(-chunk_size // shards) * shards
+    rows_per_shard = dev_rows // shards
     # Worst-case round peak: resident state + binder staging with
     # every carried depth at full width.  Informational for planning
     # (real runs prune far below it) — the gating that protects a run
@@ -172,6 +184,27 @@ def memory_envelope(bm: BatchedMastic, chunk_size: int, width: int,
         "max_pipelined_chunk_size_at_width": (
             device_budget // (PIPELINE_CHUNKS_IN_FLIGHT * per_chunk)
             if device_budget > 0 else 0),
+        # Per-shard residency: what ONE chip of the report-axis mesh
+        # holds.  The padded device rows divide evenly by design, so
+        # these are exact, not estimates.
+        "report_shards": shards,
+        "device_rows_per_chunk": dev_rows,
+        "rows_per_shard": rows_per_shard,
+        "device_bytes_per_chunk_per_shard": rows_per_shard * per_chunk,
+        "device_peak_bytes_per_chunk_per_shard":
+            rows_per_shard * per_peak,
+        "device_bytes_per_chunk_pipelined_per_shard":
+            PIPELINE_CHUNKS_IN_FLIGHT * rows_per_shard * per_chunk,
+        "device_peak_bytes_per_chunk_pipelined_per_shard":
+            PIPELINE_CHUNKS_IN_FLIGHT * rows_per_shard * per_chunk
+            + rows_per_shard * per["binder_peak"],
+        "max_chunk_size_at_width_sharded": (
+            shards * (device_budget // per_chunk)
+            if device_budget > 0 else 0),
+        "max_pipelined_chunk_size_at_width_sharded": (
+            shards * (device_budget
+                      // (PIPELINE_CHUNKS_IN_FLIGHT * per_chunk))
+            if device_budget > 0 else 0),
         "host_bytes_total": host_total,
         "device_budget_bytes": device_budget,
         "host_budget_bytes": host_budget,
@@ -191,9 +224,10 @@ def check_envelope(bm: BatchedMastic, chunk_size: int, width: int,
     mesh-sharded over `n_device_shards` devices; the host check bounds
     the carry store and names the multi-host answer when one host
     cannot hold it."""
-    env = memory_envelope(bm, chunk_size, width, num_reports)
-    per_chip = -(-env["device_bytes_per_chunk"] // n_device_shards)
-    max_chunk = env["max_chunk_size_at_width"] * n_device_shards
+    env = memory_envelope(bm, chunk_size, width, num_reports,
+                          n_device_shards)
+    per_chip = env["device_bytes_per_chunk_per_shard"]
+    max_chunk = env["max_chunk_size_at_width_sharded"]
     if env["device_budget_bytes"] > 0 \
             and per_chip > env["device_budget_bytes"]:
         chip = (f" across {n_device_shards} chips"
@@ -344,16 +378,24 @@ class HostReportStore:
                                 axis=0)
         return sl
 
-    def device_chunk(self, i: int) -> tuple[ReportBatch, np.ndarray]:
-        """Chunk i as device arrays, padded to chunk_size with dead
-        lanes (row 0 repeated).  Returns (batch, live mask)."""
+    def device_chunk(self, i: int,
+                     rows: Optional[int] = None
+                     ) -> tuple[ReportBatch, np.ndarray]:
+        """Chunk i as device arrays, padded to `rows` (default
+        chunk_size) with dead lanes (row 0 repeated).  A mesh-sharded
+        round passes rows = the next shard multiple of chunk_size so
+        the padded tile places evenly across the report axis; the live
+        mask excludes every padded lane either way.  Returns
+        (batch, live mask)."""
         from ..backend.vidpf_jax import BatchedCorrectionWords
 
+        if rows is None:
+            rows = self.chunk_size
         (lo, hi) = self.chunk_bounds(i)
 
         def take(x):
             return None if x is None \
-                else jnp.asarray(self.host_slice(x, i))
+                else jnp.asarray(_pad_rows(self.host_slice(x, i), rows))
 
         a = self.arrays
         batch = ReportBatch(
@@ -366,7 +408,7 @@ class HostReportStore:
             helper_seeds=take(a["helper_seeds"]),
             leader_seeds=take(a["leader_seeds"]),
             peer_parts=tuple(take(p) for p in a["peer_parts"]))
-        live = np.zeros(self.chunk_size, bool)
+        live = np.zeros(rows, bool)
         live[:hi - lo] = True
         return (batch, live)
 
@@ -378,6 +420,17 @@ class HostReportStore:
             elif v is not None:
                 total += v.nbytes
         return total
+
+
+def _pad_rows(x: np.ndarray, rows: int) -> np.ndarray:
+    """Pad a per-report host array's leading axis to `rows` dead lanes
+    (first row repeated — the same rule as HostReportStore.host_slice,
+    so serial and mesh-padded tiles compute identical dead-lane data
+    and the downloaded carries stay bit-identical after trimming)."""
+    pad = rows - x.shape[0]
+    if pad <= 0:
+        return x
+    return np.concatenate([x, np.repeat(x[:1], pad, axis=0)], axis=0)
 
 
 class _ChunkState(NamedTuple):
@@ -397,12 +450,25 @@ def _carry_to_host(carry):
                  ctrl=np.asarray(carry.ctrl))
 
 
-def _carry_to_device(carry):
+def _carry_to_device(carry, rows: Optional[int] = None):
     from ..backend.incremental import Carry
 
-    return Carry(w=jnp.asarray(carry.w), proof=jnp.asarray(carry.proof),
-                 seed=jnp.asarray(carry.seed),
-                 ctrl=jnp.asarray(carry.ctrl))
+    def up(x):
+        return jnp.asarray(x if rows is None else _pad_rows(x, rows))
+
+    return Carry(w=up(carry.w), proof=up(carry.proof),
+                 seed=up(carry.seed), ctrl=up(carry.ctrl))
+
+
+def _carry_trim(carry, rows: int):
+    """Drop mesh-padding lanes from a downloaded host carry (inverse
+    of _carry_to_device's pad; a no-op when nothing was padded)."""
+    from ..backend.incremental import Carry
+
+    if carry.w.shape[0] <= rows:
+        return carry
+    return Carry(w=carry.w[:rows], proof=carry.proof[:rows],
+                 seed=carry.seed[:rows], ctrl=carry.ctrl[:rows])
 
 
 def _carry_bytes(carry) -> int:
@@ -425,7 +491,8 @@ class ChunkedIncrementalRunner(RoundPrograms):
 
     def __init__(self, bm: BatchedMastic, verify_key: bytes, ctx: bytes,
                  store: HostReportStore, reports: Optional[list] = None,
-                 width: int = 8, n_device_shards: int = 1):
+                 width: int = 8, n_device_shards: int = 1,
+                 mesh=None):
         from ..backend.incremental import IncrementalMastic
 
         self.bm = bm
@@ -436,10 +503,17 @@ class ChunkedIncrementalRunner(RoundPrograms):
         self.num_reports = store.num_reports
         self.fallback = np.zeros(self.num_reports, bool)
         self.width = max(4, width)
+        # A mesh given at construction shards every chunk's report
+        # axis from round 0 (parallel/mesh.shard_incremental_runner
+        # attaching one later is equivalent — the chunked runner's
+        # cross-round state lives on the host, so there is nothing to
+        # re-place).
+        self.mesh = mesh
+        if mesh is not None:
+            n_device_shards = mesh.shape["reports"]
         self.n_device_shards = max(1, n_device_shards)
         check_envelope(bm, store.chunk_size, self.width,
                        self.num_reports, self.n_device_shards)
-        self.mesh = None  # set via parallel.mesh.shard_incremental_runner
         self.engine = IncrementalMastic(bm, self.width)
         self._init_programs()
         self._rk_fn = jax.jit(lambda n: bm.vidpf.roundkeys(ctx, n))
@@ -493,27 +567,49 @@ class ChunkedIncrementalRunner(RoundPrograms):
 
     # -- one round over every chunk --------------------------------
 
+    def _report_shards(self) -> int:
+        """Report-axis device count this runner's chunks spread over
+        (mesh wins over the construction-time hint; 1 = single chip).
+        """
+        return (self.mesh.shape["reports"] if self.mesh is not None
+                else self.n_device_shards)
+
+    def _device_rows(self) -> int:
+        """Rows of one chunk's DEVICE tile: chunk_size padded up to
+        the mesh's shard multiple (jax refuses uneven placement, so
+        an uneven tail shards by padding + masking — the dead lanes
+        are excluded from acceptance and aggregation exactly like the
+        tail chunk's existing chunk_size padding)."""
+        n = (self.mesh.shape["reports"] if self.mesh is not None
+             else 1)
+        return -(-self.store.chunk_size // n) * n
+
+    def _resident_dev_bytes(self) -> int:
+        """One device tile's resident bytes at the padded row count
+        (the measured per-chunk accounting scaled from chunk_size to
+        the mesh-padded rows)."""
+        acct = self.memory_accounting()["device_bytes_per_chunk"]
+        return acct * self._device_rows() // self.store.chunk_size
+
     def _pipeline_mode(self, plan) -> tuple:
         """(mode, fallback_reason): whether this round runs the
         double-buffered executor or degrades to serial — and why, so
-        the fallback is named in metrics, never silent."""
+        the fallback is named in metrics, never silent.  Mesh-sharded
+        rounds pipeline like single-chip ones (the r10 tentpole); the
+        budget term prices the PER-SHARD doubled footprint."""
         from .pipeline import pipeline_enabled
 
         if not pipeline_enabled():
             return ("serial", "lever-off")
         if self.store.num_chunks < 2:
             return ("serial", "single-chunk")
-        if self.mesh is not None:
-            # Mesh rounds stay on the jitted/GSPMD path; overlapping
-            # sharded uploads is future work.
-            return ("serial", "mesh")
         budget = _device_budget()
         if budget > 0:
             peak = round_peak_bytes(
                 self.bm, len(plan.onehot_idx),
-                len(plan.payload_parent), self.store.chunk_size,
-                self.memory_accounting()["device_bytes_per_chunk"],
-                self.n_device_shards,
+                len(plan.payload_parent), self._device_rows(),
+                self._resident_dev_bytes(),
+                self._report_shards(),
                 chunks_in_flight=PIPELINE_CHUNKS_IN_FLIGHT)
             if peak > budget:
                 return ("serial", "device-budget")
@@ -540,20 +636,26 @@ class ChunkedIncrementalRunner(RoundPrograms):
 
         (level, prefixes, do_weight_check) = agg_param
         plan = self._plan(prefixes, level)
-        shards = (self.mesh.shape["reports"] if self.mesh is not None
-                  else self.n_device_shards)
+        shards = self._report_shards()
+        dev_rows = self._device_rows()
         check_round_peak(
             self.bm,
             len(plan.onehot_idx), len(plan.payload_parent),
-            self.store.chunk_size,
-            self.memory_accounting()["device_bytes_per_chunk"],
-            level, shards)
+            dev_rows, self._resident_dev_bytes(), level, shards)
         (mode, fb_reason) = self._pipeline_mode(plan)
         rnd = round_inputs(plan)
         vk_arr = _vk_array(self.verify_key)
+        ones = jnp.ones(dev_rows, bool)
+        if self.mesh is not None:
+            # Small per-round inputs replicate across the mesh, the
+            # per-report ones mask shards — pinned explicitly so the
+            # warm-compiled sharded programs see exactly these
+            # shardings at dispatch (heavy_hitters.RoundPrograms).
+            from ..parallel.mesh import place_replicated, place_reports
+            (rnd, vk_arr) = place_replicated(self.mesh, (rnd, vk_arr))
+            ones = place_reports(self.mesh, ones)
         rows = len(prefixes) * (1 + self.bm.m.flp.OUTPUT_LEN)
         chunk_size = self.store.chunk_size
-        ones = jnp.ones(chunk_size, bool)
 
         agg_shares = [[self.bm.m.field(0)] * rows for _ in range(2)]
         accept_all = np.zeros(self.num_reports, bool)
@@ -565,13 +667,15 @@ class ChunkedIncrementalRunner(RoundPrograms):
         jr_ok_all: Optional[np.ndarray] = None
         warm_args: list = [None]
         warm_spent: list = [0.0]
+        psum_bytes: list = [0]
+        shard_skews: dict = {}
 
         def stage(i: int):
             """Upload chunk i and dispatch its full device chain —
             returns futures only, no blocking sync."""
             cs = self.chunks[i]
             t0 = time.perf_counter()
-            (batch, live) = self.store.device_chunk(i)
+            (batch, live) = self.store.device_chunk(i, rows=dev_rows)
             (lo, hi) = self.store.chunk_bounds(i)
             # The aggregation validity mask, known at stage time: live
             # (non-padding) lanes whose device carry was intact BEFORE
@@ -579,24 +683,24 @@ class ChunkedIncrementalRunner(RoundPrograms):
             # reproducing the serial path's fallback-then-mask order.
             valid = live.copy()
             valid[:hi - lo] &= ~self.fallback[lo:hi]
-            dev_c0 = _carry_to_device(cs.carries[0])
-            dev_c1 = _carry_to_device(cs.carries[1])
-            ext_rk = jnp.asarray(cs.ext_rk)
-            conv_rk = jnp.asarray(cs.conv_rk)
+            dev_c0 = _carry_to_device(cs.carries[0], dev_rows)
+            dev_c1 = _carry_to_device(cs.carries[1], dev_rows)
+            ext_rk = jnp.asarray(_pad_rows(cs.ext_rk, dev_rows))
+            conv_rk = jnp.asarray(_pad_rows(cs.conv_rk, dev_rows))
             valid_dev = jnp.asarray(valid)
             if self.mesh is not None:
                 # Chunk upload lands report-sharded across the mesh;
                 # aggregation below is the only cross-chip collective.
                 from ..parallel.mesh import place_reports
-                (batch, dev_c0, dev_c1, ext_rk, conv_rk) = \
+                (batch, dev_c0, dev_c1, ext_rk, conv_rk, valid_dev) = \
                     place_reports(self.mesh,
                                   (batch, dev_c0, dev_c1, ext_rk,
-                                   conv_rk))
+                                   conv_rk, valid_dev))
             t_up = time.perf_counter()
             args = (vk_arr, dev_c0, dev_c1, rnd, ext_rk, conv_rk,
                     batch.cws)
             (eval_prog, compile_s) = self._eval_program(
-                chunk_size, plan, args)
+                dev_rows, plan, args)
             t_d0 = time.perf_counter()
             (c0, c1, out0, out1, accept_ev, ok) = eval_prog(*args)
             wc_checks = {}
@@ -609,7 +713,7 @@ class ChunkedIncrementalRunner(RoundPrograms):
             cargs = (out0, out1, accept_ev, ok, valid_dev,
                      wc_accept, wc_okdev, jr)
             (agg_prog, agg_compile_s) = self._agg_program(
-                chunk_size, cargs)
+                dev_rows, cargs)
             (accept_dev, agg0, agg1) = agg_prog(*cargs)
             t_d1 = time.perf_counter()
             if warm_args[0] is None:
@@ -633,12 +737,26 @@ class ChunkedIncrementalRunner(RoundPrograms):
             cs = self.chunks[i]
             (lo, hi) = self.store.chunk_bounds(i)
             t0 = time.perf_counter()
+            if self.mesh is not None and shards > 1:
+                # Per-shard completion skew, measured inside the
+                # chunk's one sync window: block the report-sharded
+                # accept mask shard by shard (device order) before the
+                # global sync — the straggler shard shows up as the
+                # max-min spread.  Observability only; the arithmetic
+                # never depends on it.
+                waits = []
+                for sh in accept_dev.addressable_shards:
+                    sh.data.block_until_ready()
+                    waits.append((time.perf_counter() - t0) * 1e3)
+                shard_skews[i] = round(max(waits) - min(waits), 3)
+                # One psum per aggregator's replicated aggregate.
+                psum_bytes[0] += agg0.nbytes + agg1.nbytes
             jax.block_until_ready(
                 (c0, c1, accept_ev, ok, wc_checks, wc_okdev,
                  accept_dev, agg0, agg1))
             t_wait = time.perf_counter()
-            cs.carries[0] = _carry_to_host(c0)
-            cs.carries[1] = _carry_to_host(c1)
+            cs.carries[0] = _carry_trim(_carry_to_host(c0), chunk_size)
+            cs.carries[1] = _carry_trim(_carry_to_host(c1), chunk_size)
             ok_np = np.asarray(ok)
             accept_ev_np = np.asarray(accept_ev)
             accept_np = np.asarray(accept_dev)
@@ -676,7 +794,7 @@ class ChunkedIncrementalRunner(RoundPrograms):
             # computes through them (see pipeline.ProgramCache for
             # why this is synchronous, not a compiler thread).
             warm_spent[0] = self._warm_next(plan, warm_args[0],
-                                            chunk_size)
+                                            dev_rows)
 
         from .pipeline import paused_gc
         with paused_gc():
@@ -697,13 +815,26 @@ class ChunkedIncrementalRunner(RoundPrograms):
             rec["wall_ms"] = round(span_s * 1e3, 2)
             # Live-report rate (comparable across full and partial
             # chunks) AND the padded device-work rate — the tail chunk
-            # computes chunk_size padded lanes but only hi-lo of them
-            # are reports, so the old single padded-rate stamp
-            # overstated tail throughput.
+            # computes dev_rows padded lanes but only hi-lo of them
+            # are reports, so a single padded-rate stamp would
+            # overstate tail throughput (r9's honesty fix, extended
+            # to the mesh's shard-multiple padding).
             rec["node_evals_per_sec"] = round(
                 (hi - lo) * evals_per_report / span_s, 1)
             rec["node_evals_per_sec_padded"] = round(
-                chunk_size * evals_per_report / span_s, 1)
+                dev_rows * evals_per_report / span_s, 1)
+            if self.mesh is not None:
+                # Per-shard twins of both stamps: each chip computes
+                # dev_rows/shards lanes of the chunk, so the per-shard
+                # rate is the number the single-chip roofline compares
+                # against (PERF.md §8).
+                rec["node_evals_per_sec_per_shard"] = round(
+                    rec["node_evals_per_sec"] / shards, 1)
+                rec["node_evals_per_sec_padded_per_shard"] = round(
+                    rec["node_evals_per_sec_padded"] / shards, 1)
+                if rec["chunk"] in shard_skews:
+                    rec["shard_wait_skew_ms"] = \
+                        shard_skews[rec["chunk"]]
         chunk_stats = timeline
 
         assert level == len(self.layouts)
@@ -732,9 +863,24 @@ class ChunkedIncrementalRunner(RoundPrograms):
                                                      wall_ms),
             "compile_inline_ms": round(compile_inline_ms, 2),
             "warm_ms": round(warm_spent[0] * 1e3, 2),
-            "aot": self._aot_summary(chunk_size, plan,
+            "aot": self._aot_summary(dev_rows, plan,
                                      compile_inline_ms),
         }
+        if self.mesh is not None:
+            # Collective overhead made observable (not inferred): one
+            # psum of each aggregator's O(frontier) aggregate share
+            # per chunk is the round's ONLY cross-chip traffic.
+            skews = sorted(shard_skews.values())
+            metrics.extra["mesh"] = {
+                "report_shards": shards,
+                "device_rows_per_chunk": dev_rows,
+                "rows_per_shard": dev_rows // shards,
+                "psum_bytes_per_round": psum_bytes[0],
+                "shard_wait_skew_ms_p50":
+                    (skews[len(skews) // 2] if skews else 0.0),
+                "shard_wait_skew_ms_max":
+                    (skews[-1] if skews else 0.0),
+            }
 
         splice_rejected(self.bm.m, self.verify_key, self.ctx, agg_param,
                         self.reports, ~self.fallback, accept_all,

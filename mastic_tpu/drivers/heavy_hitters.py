@@ -300,9 +300,7 @@ class HeavyHittersRun:
             self.batch = None
             self.num_reports = store.num_reports
             self.runner = ChunkedIncrementalRunner(
-                self.bm, verify_key, ctx, store, reports,
-                n_device_shards=(mesh.shape["reports"]
-                                 if mesh is not None else 1))
+                self.bm, verify_key, ctx, store, reports, mesh=mesh)
         else:
             self.store = None
             self.batch = (batch if batch is not None
@@ -615,6 +613,25 @@ class RoundPrograms:
         self.programs = ProgramCache()
         self._warmed_keys: set = set()
 
+    # -- mesh plumbing (report-axis data parallelism) --------------
+
+    def _mesh_shards(self) -> int:
+        """Report-axis size of the installed mesh (0 = no mesh) — part
+        of every program-cache key, so a grown-or-resharded runner maps
+        to fresh keys instead of replaying a mismatched executable."""
+        return (self.mesh.shape["reports"] if self.mesh is not None
+                else 0)
+
+    def _rep_sharding(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return NamedSharding(self.mesh, P("reports"))
+
+    def _repl_sharding(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return NamedSharding(self.mesh, P())
+
     def _eval_jit(self):
         if self._eval_fn is None:
             engine = self.engine
@@ -632,7 +649,14 @@ class RoundPrograms:
             # outputs (resident keeps them resident; chunked re-uploads
             # fresh buffers every chunk).  The verify key is traced so
             # a fresh per-collection key reuses the compiled program.
-            self._eval_fn = jax.jit(both, donate_argnums=(1, 2))
+            # Under a mesh every output is pinned report-sharded so the
+            # eval -> combine handoff has deterministic shardings (the
+            # AOT warm lowers against exactly these).
+            kwargs: dict = {"donate_argnums": (1, 2)}
+            if self.mesh is not None:
+                rep = self._rep_sharding()
+                kwargs["out_shardings"] = (rep,) * 6
+            self._eval_fn = jax.jit(both, **kwargs)
         return self._eval_fn
 
     def _combine_jit(self):
@@ -654,7 +678,19 @@ class RoundPrograms:
                 return (accept, bm.aggregate(out0, accept),
                         bm.aggregate(out1, accept))
 
-            self._combine_fn = jax.jit(combine)
+            kwargs: dict = {}
+            if self.mesh is not None:
+                # The masked sum over the report-sharded axis is THE
+                # round's only cross-chip collective: GSPMD lowers it
+                # to per-shard partial sums + a psum over ICI, and the
+                # replicated output sharding makes that explicit.
+                # Field addition is exact modular integer math, so the
+                # shard-then-psum order is bit-identical to the serial
+                # single-device sum.
+                kwargs["out_shardings"] = (
+                    self._rep_sharding(), self._repl_sharding(),
+                    self._repl_sharding())
+            self._combine_fn = jax.jit(combine, **kwargs)
         return self._combine_fn
 
     # -- shape-keyed AOT programs (drivers/pipeline.py) ------------
@@ -662,25 +698,24 @@ class RoundPrograms:
     def _eval_key(self, rows: int, plan) -> tuple:
         from .pipeline import plan_shape_key
 
-        return ("eval", rows) + plan_shape_key(plan)
+        return ("eval", rows, self._mesh_shards()) \
+            + plan_shape_key(plan)
 
     def _agg_key(self, rows: int, out_cols: int) -> tuple:
-        return ("agg", rows, out_cols)
+        return ("agg", rows, self._mesh_shards(), out_cols)
 
     def _eval_program(self, rows: int, plan, args) -> tuple:
-        """(program, compile_wait_seconds) for this round's eval.
-        Mesh runs stay on the jitted path (AOT lowering would need
-        explicit shardings); single-device runs get the cached
-        executable, compiled inline only when prediction missed."""
-        if self.mesh is not None:
-            return (self._eval_jit(), 0.0)
+        """(program, compile_wait_seconds) for this round's eval:
+        the cached AOT executable, compiled inline only when
+        prediction missed.  Mesh rounds use the same path — lowering
+        from the concretely placed args bakes their NamedShardings
+        into the program (and the cache key carries the mesh shape),
+        so steady-state sharded rounds are zero-inline-compile too."""
         return self.programs.get(
             self._eval_key(rows, plan),
             lambda: self._eval_jit().lower(*args))
 
     def _agg_program(self, rows: int, cargs) -> tuple:
-        if self.mesh is not None:
-            return (self._combine_jit(), 0.0)
         return self.programs.get(
             self._agg_key(rows, cargs[0].shape[1]),
             lambda: self._combine_jit().lower(*cargs))
@@ -698,7 +733,7 @@ class RoundPrograms:
         from ..backend.incremental import round_inputs
         from . import pipeline as pl
 
-        if self.mesh is not None or not pl.pipeline_enabled():
+        if not pl.pipeline_enabled():
             return 0.0
         structs = jax.tree_util.tree_map(pl.to_struct, args)
         layouts_next = list(self.layouts) + [plan.layout_new]
@@ -706,21 +741,34 @@ class RoundPrograms:
         n = self.bm.spec.num_limbs
         eval_jit = self._eval_jit()
         combine_jit = self._combine_jit()
+        # Mesh rounds warm with the shardings the real call passes:
+        # per-report tensors P("reports"), the small round inputs
+        # replicated (mirroring place_reports / place_replicated in
+        # the runners' stage phase).
+        (rep, repl) = ((self._rep_sharding(), self._repl_sharding())
+                       if self.mesh is not None else (None, None))
+
+        def struct(shape, dtype, sharding):
+            if sharding is None:
+                return jax.ShapeDtypeStruct(shape, dtype)
+            return jax.ShapeDtypeStruct(shape, dtype,
+                                        sharding=sharding)
+
         spent = 0.0
         for nplan in pl.predicted_next_plans(
                 plan.prefixes, plan.level, self.bm.m.vidpf.BITS,
                 self.width, layouts_next):
-            nrnd = jax.tree_util.tree_map(pl.to_struct,
-                                          round_inputs(nplan))
+            nrnd = jax.tree_util.tree_map(
+                lambda x: struct(x.shape, x.dtype, repl),
+                round_inputs(nplan))
             eargs = structs[:3] + (nrnd,) + structs[4:]
             ekey = self._eval_key(rows, nplan)
             self._warmed_keys.add(ekey)
             spent += self.programs.warm(
                 ekey, lambda: eval_jit.lower(*eargs))
             out_cols = len(nplan.out_idx) * out_len
-            s_out = jax.ShapeDtypeStruct((rows, out_cols, n),
-                                         jnp.uint32)
-            s_mask = jax.ShapeDtypeStruct((rows,), jnp.bool_)
+            s_out = struct((rows, out_cols, n), jnp.uint32, rep)
+            s_mask = struct((rows,), jnp.bool_, rep)
             cargs = (s_out, s_out) + (s_mask,) * 6
             akey = self._agg_key(rows, out_cols)
             self._warmed_keys.add(akey)
@@ -745,8 +793,13 @@ class RoundPrograms:
         fn = self._wc_fns.get(level)
         if fn is None:
             (bm, ctx) = (self.bm, self.ctx)
+            kwargs: dict = {}
+            if self.mesh is not None:
+                # Per-report verdict masks stay report-sharded so the
+                # combine program's warm-lowered input shardings match.
+                kwargs["out_shardings"] = self._rep_sharding()
             fn = jax.jit(lambda vk, b, w0, w1: bm.weight_check_device(
-                vk, ctx, level, b, w0, w1))
+                vk, ctx, level, b, w0, w1), **kwargs)
             self._wc_fns[level] = fn
         return fn
 
@@ -878,6 +931,16 @@ class _IncrementalRunner(RoundPrograms):
             vk_arr = _vk_array(self.verify_key)
             valid = jnp.asarray(~self.fallback)
             ones = jnp.ones(self.num_reports, bool)
+            if self.mesh is not None:
+                # Deterministic shardings for the AOT programs: small
+                # round inputs replicated, per-report masks sharded
+                # (mirrors the chunked runner's stage placement).
+                from ..parallel.mesh import (place_replicated,
+                                             place_reports)
+                (rnd, vk_arr) = place_replicated(self.mesh,
+                                                 (rnd, vk_arr))
+                (valid, ones) = place_reports(self.mesh,
+                                              (valid, ones))
             t_up = time.perf_counter()
 
             args = (vk_arr, self.carries[0], self.carries[1], rnd,
@@ -914,6 +977,17 @@ class _IncrementalRunner(RoundPrograms):
 
         # The round's single blocking sync: everything above is an
         # in-flight future until here.
+        shard_skew = None
+        if self.mesh is not None \
+                and self.mesh.shape["reports"] > 1:
+            # Per-shard completion skew inside the one sync window
+            # (same probe as the chunked collect); observability only.
+            t_sk = time.perf_counter()
+            waits = []
+            for sh in accept_dev.addressable_shards:
+                sh.data.block_until_ready()
+                waits.append((time.perf_counter() - t_sk) * 1e3)
+            shard_skew = round(max(waits) - min(waits), 3)
         jax.block_until_ready(
             (accept_dev, agg0, agg1, ok, wc_okdev))
         t_wait = time.perf_counter()
@@ -953,9 +1027,19 @@ class _IncrementalRunner(RoundPrograms):
         metrics.rejected_fallback = int((self.fallback & ~accept).sum())
         t_host = time.perf_counter()
         compile_ms = (compile_s + agg_compile_s) * 1e3
+        if self.mesh is not None:
+            metrics.extra["mesh"] = {
+                "report_shards": self.mesh.shape["reports"],
+                "device_rows_per_chunk": self.num_reports,
+                "rows_per_shard": (self.num_reports
+                                   // self.mesh.shape["reports"]),
+                "psum_bytes_per_round": agg0.nbytes + agg1.nbytes,
+                "shard_wait_skew_ms_p50": shard_skew or 0.0,
+                "shard_wait_skew_ms_max": shard_skew or 0.0,
+            }
         metrics.extra["pipeline"] = {
             "mode": "resident-deferred",
-            "fallback": "mesh" if self.mesh is not None else None,
+            "fallback": None,
             "overlap_efficiency": 0.0,  # one chunk: nothing to overlap
             "compile_inline_ms": round(compile_ms, 2),
             "phases": {

@@ -171,7 +171,20 @@ def overlap_efficiency(timeline: Sequence[dict],
 # -- shape-keyed compiled-program cache + background warming ----------
 
 def to_struct(x) -> jax.ShapeDtypeStruct:
-    """Array -> abstract shape/dtype (the lowering signature)."""
+    """Array -> abstract shape/dtype (the lowering signature).
+
+    Mesh-placed arrays keep their NamedSharding: a warm compile for a
+    mesh-sharded round must lower with the same input shardings the
+    real call will pass, or the cached executable would be rejected
+    (or silently recompiled) at dispatch.  Single-device arrays stay
+    sharding-free — pinning their SingleDeviceSharding would
+    needlessly specialize the program to one device ordinal."""
+    from jax.sharding import NamedSharding
+
+    sharding = getattr(x, "sharding", None)
+    if isinstance(sharding, NamedSharding):
+        return jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                    sharding=sharding)
     return jax.ShapeDtypeStruct(x.shape, x.dtype)
 
 

@@ -10,10 +10,11 @@ ICI.  Inter-*party* traffic (leader <-> helper) stays on the host/DCN
 boundary carrying the byte-exact wire messages (mastic_tpu.mastic).
 """
 
-from .mesh import (install_grid_sharding, make_mesh, place_reports,
-                   shard_batch, shard_incremental_runner,
-                   sharded_gen_fn, sharded_prep_fn, sharded_round_fn)
+from .mesh import (install_grid_sharding, make_mesh, place_replicated,
+                   place_reports, shard_batch,
+                   shard_incremental_runner, sharded_gen_fn,
+                   sharded_prep_fn, sharded_round_fn)
 
-__all__ = ["install_grid_sharding", "make_mesh", "place_reports",
-           "shard_batch", "shard_incremental_runner",
+__all__ = ["install_grid_sharding", "make_mesh", "place_replicated",
+           "place_reports", "shard_batch", "shard_incremental_runner",
            "sharded_gen_fn", "sharded_prep_fn", "sharded_round_fn"]

@@ -129,6 +129,23 @@ def place_reports(mesh: Mesh, tree):
     return jax.tree.map(put, tree, is_leaf=lambda x: x is None)
 
 
+def place_replicated(mesh: Mesh, tree):
+    """Place every array in a pytree fully replicated across the mesh
+    (the small per-round inputs — verify key, gather index tensors —
+    that every shard reads in full).  Pinning these explicitly keeps
+    the AOT-compiled round programs' input shardings deterministic: a
+    warm-compiled executable and the inline-lowered one agree on every
+    argument's placement (drivers/pipeline.ProgramCache)."""
+    repl = NamedSharding(mesh, P())
+
+    def put(x):
+        if x is None:
+            return None
+        return jax.device_put(x, repl)
+
+    return jax.tree.map(put, tree, is_leaf=lambda x: x is None)
+
+
 def shard_incremental_runner(runner, mesh: Mesh) -> None:
     """Make an incremental runner mesh-aware (SURVEY.md §7 step 7 for
     the production execution model): both aggregators' carries, the
@@ -144,14 +161,26 @@ def shard_incremental_runner(runner, mesh: Mesh) -> None:
     runner.mesh)."""
     n_rep = mesh.shape["reports"]
     store = getattr(runner, "store", None)
-    per_device = (store.chunk_size if store is not None
-                  else runner.num_reports)
-    if per_device % n_rep != 0:
-        what = "chunk_size" if store is not None else "report count"
+    if store is None and runner.num_reports % n_rep != 0:
+        # The resident batch IS the device tile — it must shard
+        # evenly.  A chunked runner pads each chunk's device rows up
+        # to the shard multiple instead and masks the dead lanes
+        # (ChunkedIncrementalRunner._device_rows), so any chunk_size
+        # works on any mesh.
         raise ValueError(
-            f"{what} {per_device} must be divisible by the mesh's "
-            f"reports axis ({n_rep}) to shard evenly")
+            f"report count {runner.num_reports} must be divisible by "
+            f"the mesh's reports axis ({n_rep}) to shard evenly")
     runner.mesh = mesh
+    # The jitted round closures bake the mesh's output shardings in
+    # (RoundPrograms builds them with explicit out_shardings when a
+    # mesh is installed), so attaching a mesh after construction must
+    # rebind them; the AOT ProgramCache keys on the mesh shape, so
+    # its entries simply stop being reachable.
+    for name in ("_eval_fn", "_combine_fn"):
+        if hasattr(runner, name):
+            setattr(runner, name, None)
+    if hasattr(runner, "_wc_fns"):
+        runner._wc_fns = {}
     if getattr(runner, "carries", None) is not None:
         runner.carries = [place_reports(mesh, c)
                           for c in runner.carries]

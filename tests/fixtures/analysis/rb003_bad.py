@@ -1,0 +1,12 @@
+"""Known-bad: report-batched upload via bare device_put (RB003)."""
+
+import jax
+from jax import device_put
+
+
+def upload_chunk(mesh, batch, carry):
+    # Lands the whole chunk on one device: a mesh round would reshard
+    # it through a layout mismatch instead of streaming per-shard.
+    dev_batch = jax.device_put(batch)
+    dev_carry = device_put(carry)
+    return (dev_batch, dev_carry)

@@ -1,0 +1,10 @@
+"""Known-good: uploads route through the mesh placement helpers
+(RB003) — no bare device_put anywhere."""
+
+
+def upload_chunk(mesh, batch, carry, vk_arr):
+    from mastic_tpu.parallel.mesh import place_replicated, place_reports
+
+    (dev_batch, dev_carry) = place_reports(mesh, (batch, carry))
+    vk_dev = place_replicated(mesh, vk_arr)
+    return (dev_batch, dev_carry, vk_dev)

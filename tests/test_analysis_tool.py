@@ -32,6 +32,7 @@ CASES = {
     "PL004": ("pl004_bad.py", "pl004_good.py", "pallasck"),
     "RB001": ("rb001_bad.py", "rb001_good.py", "robustness"),
     "RB002": ("rb002_bad.py", "rb002_good.py", "robustness"),
+    "RB003": ("rb003_bad.py", "rb003_good.py", "robustness"),
 }
 
 

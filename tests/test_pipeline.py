@@ -503,10 +503,13 @@ def test_grow_then_weight_check(monkeypatch) -> None:
     # The weight check fired at the grown width and still attributed.
     assert grown.metrics[0].rejected_weight_check == 1
     assert grown.metrics[0].padded_width == 16
-    # Every compiled program key carries the width it closed over.
+    # Every compiled program key carries the width it closed over
+    # (key layout: ("eval", rows, mesh_shards, width, buckets...) —
+    # r10 added the mesh shape at slot 2; no mesh here, so 0).
     eval_keys = [k for k in grown.runner.programs._programs
                  if k[0] == "eval"]
-    assert eval_keys and all(k[2] == 16 for k in eval_keys)
+    assert eval_keys and all(k[2] == 0 and k[3] == 16
+                             for k in eval_keys)
 
 
 # -- composition: checkpoint kill-resume with faults armed -----------

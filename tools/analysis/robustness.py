@@ -20,6 +20,17 @@ pass keeps out of the tree:
          are how a faulted session degrades invisibly instead of
          landing in a counter.
 
+  RB003  a direct `device_put` in drivers/.  Report-batched uploads
+         must route through `parallel.mesh.place_reports` /
+         `place_replicated` (which carry the mesh's NamedSharding):
+         a bare `jax.device_put` silently lands the array on ONE
+         device, so a mesh-sharded round would replicate-or-gather
+         it through a layout mismatch instead of streaming per-shard
+         — exactly the class of bug the r10 mesh executor's
+         bit-identity tests cannot see (the math still comes out
+         right, only the placement and the interconnect traffic go
+         wrong).  Genuinely single-device puts carry an allow.
+
 Intentional exceptions are suppressed inline with a justified
 `# mastic-allow: RB00x — reason`, same as every other pass.
 """
@@ -34,6 +45,8 @@ RULES = {
     "RB001": "blocking socket read without a deadline",
     "RB002": "except block swallows the error without re-raise or "
              "structured report",
+    "RB003": "direct device_put in drivers/ bypasses "
+             "place_reports' mesh placement",
 }
 
 SCOPE_PREFIX = "mastic_tpu/drivers/"
@@ -147,10 +160,35 @@ def _check_rb002(info, findings) -> None:
                 f"record it (counter/log/return)"))
 
 
+def _check_rb003(info, findings) -> None:
+    """Flag `device_put` calls however spelled (jax.device_put, a
+    bare imported device_put) — the drivers' sanctioned upload paths
+    are parallel.mesh.place_reports / place_replicated, which carry
+    the installed mesh's NamedSharding."""
+    for node in ast.walk(info.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = (f.attr if isinstance(f, ast.Attribute)
+                else f.id if isinstance(f, ast.Name) else None)
+        if name != "device_put":
+            continue
+        findings.append(Finding(
+            "RB003", info.rel, node.lineno,
+            "direct device_put bypasses place_reports — when a mesh "
+            "is installed this lands the array on one device and the "
+            "round pays a layout reshard instead of streaming "
+            "per-shard; route report-batched uploads through "
+            "parallel.mesh.place_reports (replicated scalars through "
+            "place_replicated), or allow a genuinely single-device "
+            "put"))
+
+
 def check(info) -> list:
     findings: list = []
     _check_rb001(info, findings)
     _check_rb002(info, findings)
+    _check_rb003(info, findings)
     seen = set()
     out = []
     for f in findings:

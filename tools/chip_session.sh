@@ -68,6 +68,15 @@ run level-pallas python bench.py --headline-only --level-pallas
 run pipeline-on python bench.py --chunked-round-only --pipeline on
 run pipeline-off python bench.py --chunked-round-only --pipeline off
 
+# 5. Mesh-sharded production round (r10, drivers/chunked.py +
+# parallel/mesh.py): the chunked pipelined round at --mesh 1 vs every
+# attached chip, so the next tunnel window measures multi-chip
+# scaling (per-shard rate, psum bytes, shard skew) unattended.  The
+# r10 bit-identity proof itself runs in CI (make multichip); these
+# cells are the HARDWARE rate measurement.
+run mesh-1 python bench.py --chunked-round-only --mesh 1
+run mesh-all python bench.py --chunked-round-only --mesh all
+
 # Every on-chip run persists itself to BENCH_LAST_GOOD; end on the
 # default configuration so the cached record reflects the default
 # levers, not whichever matrix cell happened to run last.

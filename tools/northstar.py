@@ -208,12 +208,17 @@ def main() -> None:
             sys.exit(2)
 
     if args.mesh:
-        if args.chunk_size % args.mesh:
-            # Fail before the multi-minute shard phase, not after it.
+        # Chunked mode shards ANY chunk_size: the runner pads each
+        # chunk's device rows to the shard multiple and masks the dead
+        # lanes (drivers/chunked.ChunkedIncrementalRunner._device_rows)
+        # — the old parse-time divisibility refusal is gone.  Resident
+        # mode's batch IS the device tile, so it still must divide;
+        # fail before the multi-minute shard phase, not after it.
+        if args.resident and args.reports % args.mesh:
             parser.error(
-                f"--chunk-size {args.chunk_size} must be divisible by "
-                f"--mesh {args.mesh} (the chunk's report axis shards "
-                f"evenly across devices)")
+                f"--reports {args.reports} must be divisible by "
+                f"--mesh {args.mesh} in --resident mode (the resident "
+                f"batch shards without padding; chunked mode pads)")
         # Virtual device count must be pinned before jax import.
         flags = os.environ.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in flags:
@@ -464,11 +469,33 @@ def main() -> None:
             "fallbacks": sorted({p["fallback"] for p in pipe_rounds
                                  if p["fallback"]}),
         }
+    # Mesh summary (drivers/chunked.py stamps extra["mesh"]): psum
+    # bytes and shard skew per round, so the collective overhead at
+    # scale is a recorded number, not an inference.
+    mesh_rounds = [mx.extra["mesh"] for mx in run.metrics
+                   if "mesh" in mx.extra]
+    mesh_out = None
+    if mesh_rounds:
+        skews = sorted(mr["shard_wait_skew_ms_max"]
+                       for mr in mesh_rounds)
+        mesh_out = {
+            "report_shards": mesh_rounds[-1]["report_shards"],
+            "device_rows_per_chunk":
+                mesh_rounds[-1]["device_rows_per_chunk"],
+            "rows_per_shard": mesh_rounds[-1]["rows_per_shard"],
+            "psum_bytes_total": sum(mr["psum_bytes_per_round"]
+                                    for mr in mesh_rounds),
+            "psum_bytes_per_round_last":
+                mesh_rounds[-1]["psum_bytes_per_round"],
+            "shard_wait_skew_ms_p50": skews[len(skews) // 2],
+            "shard_wait_skew_ms_max": skews[-1],
+        }
     # Envelope at the FINAL width — a frontier that forced _grow must
     # be reflected next to the measured accounting.  Resident mode's
     # "chunk" is the entire batch.
     envelope = memory_envelope(bm, R if args.resident else C,
-                               run.runner.width, R)
+                               run.runner.width, R,
+                               n_device_shards=args.mesh or 1)
     p50 = (sorted(chunk_rates)[len(chunk_rates) // 2]
            if chunk_rates else 0.0)
     out = {
@@ -484,6 +511,10 @@ def main() -> None:
         "node_evals_total": evals_total,
         "node_evals_per_sec": round(evals_total / agg_wall, 1),
         "per_chunk_evals_per_sec_p50": round(p50, 1),
+        # Per-shard twin of the p50 (live rate / report shards): the
+        # number to hold against the single-chip roofline (PERF.md §8).
+        "per_chunk_evals_per_sec_per_shard_p50": round(
+            p50 / (args.mesh or 1), 1),
         "memory": mem,
         "envelope": envelope,
         "heavy_hitters_found": len(hitters),
@@ -492,6 +523,8 @@ def main() -> None:
     }
     if pipeline_out is not None:
         out["pipeline"] = pipeline_out
+    if mesh_out is not None:
+        out["mesh"] = mesh_out
     if args.inst == "sum":
         out["max_weight"] = args.max_weight
     if resumed_from is not None:
