@@ -14,6 +14,13 @@
 #                session layer (deadlines, injection, quarantine,
 #                respawn; USAGE.md "Fault model & injection") —
 #                fast tier only; the full-round matrix is slow-tier
+#   make serve-smoke  collector-service gate (drivers/service.py):
+#                fast tier of tests/test_service.py (admission,
+#                backpressure, ingest faults, offline bit-identity
+#                incl. mid-epoch snapshot resume) plus the in-process
+#                tools/serve.py --smoke scenario (two tenants,
+#                malformed burst, overload under both shed policies,
+#                deadline miss, crash drill)
 #   make pipeline  pipelined chunk-streaming executor suite
 #                (drivers/pipeline.py: serial bit-identity, overlap
 #                timeline, AOT bucket compile, budget fallback) —
@@ -30,14 +37,24 @@
 
 PY ?= python
 
-.PHONY: ci lint analyze faults pipeline multichip typecheck \
-	test-fast test test-slow test-slow-1 test-slow-2 test-slow-3 \
-	bench
+.PHONY: ci lint analyze faults serve-smoke pipeline multichip \
+	typecheck test-fast test test-slow test-slow-1 test-slow-2 \
+	test-slow-3 bench
 
-ci: lint analyze faults pipeline multichip typecheck test-fast
+ci: lint analyze faults serve-smoke pipeline multichip typecheck \
+	test-fast
 
 faults:
 	$(PY) -m pytest tests/test_faults.py -q -m "not slow"
+
+# The offline-bit-identity + mid-epoch-resume acceptance test is
+# slow-marked (it costs ~3 min of cold compile, which would blow the
+# plain fast tier's budget) but runs HERE by explicit node id — it
+# is this gate's acceptance test.
+serve-smoke:
+	$(PY) -m pytest tests/test_service.py -q -m "not slow"
+	$(PY) -m pytest -q "tests/test_service.py::test_epoch_bit_identical_to_offline_with_mid_epoch_resume"
+	JAX_PLATFORMS=cpu $(PY) tools/serve.py --smoke
 
 pipeline:
 	$(PY) -m pytest tests/test_pipeline.py -q -m "not slow"
@@ -61,12 +78,14 @@ typecheck:
 		     "scalar layer) - skipping"; \
 	fi
 
-# test_faults' / test_pipeline's / test_mesh_pipeline's fast tiers
-# already ran as their own gates right after analyze — skip them here
-# so `make ci` doesn't pay for them twice.
+# test_faults' / test_service's / test_pipeline's /
+# test_mesh_pipeline's fast tiers already ran as their own gates
+# right after analyze — skip them here so `make ci` doesn't pay for
+# them twice.
 test-fast:
 	$(PY) -m pytest tests/ -q -m "not slow" \
 		--ignore=tests/test_faults.py \
+		--ignore=tests/test_service.py \
 		--ignore=tests/test_pipeline.py \
 		--ignore=tests/test_mesh_pipeline.py
 

@@ -6,12 +6,18 @@ with the host orchestrating the multi-round collector loop."""
 from .heavy_hitters import (HeavyHittersRun, compute_heavy_hitters,
                             get_threshold,
                             get_reports_from_measurements, run_round)
-from .attribute_metrics import aggregate_by_attribute, hash_attribute
+from .attribute_metrics import (AttributeMetricsRun,
+                                aggregate_by_attribute,
+                                hash_attribute)
 from .communication import communication_report
+from .service import (CollectionRun, CollectorService, ServiceConfig,
+                      TenantSpec, encode_upload)
 
 __all__ = [
     "HeavyHittersRun", "compute_heavy_hitters", "get_threshold",
     "get_reports_from_measurements", "run_round",
-    "aggregate_by_attribute", "hash_attribute",
+    "AttributeMetricsRun", "aggregate_by_attribute", "hash_attribute",
     "communication_report",
+    "CollectionRun", "CollectorService", "ServiceConfig",
+    "TenantSpec", "encode_upload",
 ]

@@ -9,6 +9,8 @@ candidate prefixes being the collector's attributes of interest.
 """
 
 import hashlib
+import json
+import time
 from typing import Optional, Sequence
 
 from ..common import gen_rand
@@ -48,31 +50,128 @@ def aggregate_by_attribute(mastic: Mastic, ctx: bytes,
     uneven — same rule as the chunked heavy-hitters runner) and the
     masked aggregation's psum is the round's only cross-chip
     collective; bit-identical to the single-device result either way.
+
+    Internally one `AttributeMetricsRun` — the same scheduler-facing
+    round loop the collector service drives (drivers/service.py), so
+    the offline call and the service epoch execute the identical
+    code path.
     """
-    if verify_key is None:
-        verify_key = gen_rand(mastic.VERIFY_KEY_SIZE)
-    bm = BatchedMastic(mastic)
-    level = mastic.vidpf.BITS - 1
-    prefixes = tuple(hash_attribute(mastic, a) for a in attributes)
-    if len(set(prefixes)) != len(prefixes):
-        raise ValueError("attribute hash collision; increase BITS")
-    agg_param = (level, prefixes, True)
-    assert mastic.is_valid(agg_param, [])
-    if chunk_size is not None and chunk_size < 1:
-        raise ValueError(f"chunk_size must be >= 1, got {chunk_size}")
-    if chunk_size is None and mesh is not None:
-        # The mesh path needs the padded+masked chunk machinery for
-        # uneven report counts — stream as one chunk.
-        chunk_size = len(reports)
-    if chunk_size is None:
-        batch = bm.marshal_reports(reports)
-        result = run_round(bm, verify_key, ctx, agg_param, batch,
-                           reports, metrics_out=metrics_out)
-    else:
-        result = _run_round_chunked(bm, verify_key, ctx, agg_param,
-                                    reports, chunk_size, metrics_out,
-                                    mesh=mesh)
-    return list(zip(attributes, result))
+    run = AttributeMetricsRun(mastic, ctx, attributes, reports,
+                              verify_key=verify_key,
+                              chunk_size=chunk_size, mesh=mesh)
+    while run.step():
+        pass
+    if metrics_out is not None:
+        metrics_out.extend(run.metrics)
+    return run.result()
+
+
+class AttributeMetricsRun:
+    """The attribute-metrics mode behind the scheduler-facing
+    `CollectionRun` interface (drivers/service.py): a single
+    weight-checked aggregation round at the last level, exposed as a
+    one-step run so the epoch scheduler multiplexes it exactly like
+    the multi-round heavy-hitters loop.
+
+    Checkpoint contract: `to_bytes()` before the round records only
+    that nothing ran (a resumed epoch re-runs the round — it is one
+    deterministic dispatch over the replayed reports, so the rerun is
+    bit-identical); after the round it records the final result, so a
+    resumed finished epoch replays without touching the device.
+    """
+
+    def __init__(self, mastic: Mastic, ctx: bytes,
+                 attributes: Sequence[str], reports: list,
+                 verify_key: Optional[bytes] = None,
+                 chunk_size: Optional[int] = None, mesh=None):
+        if verify_key is None:
+            verify_key = gen_rand(mastic.VERIFY_KEY_SIZE)
+        if chunk_size is not None and chunk_size < 1:
+            raise ValueError(
+                f"chunk_size must be >= 1, got {chunk_size}")
+        prefixes = tuple(hash_attribute(mastic, a) for a in attributes)
+        if len(set(prefixes)) != len(prefixes):
+            raise ValueError("attribute hash collision; increase BITS")
+        self.mastic = mastic
+        self.ctx = ctx
+        self.attributes = list(attributes)
+        self.reports = reports
+        self.verify_key = verify_key
+        self.chunk_size = chunk_size
+        self.mesh = mesh
+        self.prefixes = prefixes
+        self.metrics: list = []
+        self.done = False
+        self._result: Optional[list] = None
+
+    def step(self) -> bool:
+        """Run the single aggregation round.  Returns False (no more
+        rounds) — matching the step() contract of HeavyHittersRun."""
+        if self.done:
+            return False
+        m = self.mastic
+        bm = BatchedMastic(m)
+        level = m.vidpf.BITS - 1
+        agg_param = (level, self.prefixes, True)
+        assert m.is_valid(agg_param, [])
+        chunk_size = self.chunk_size
+        if chunk_size is None and self.mesh is not None:
+            # The mesh path needs the padded+masked chunk machinery
+            # for uneven report counts — stream as one chunk.
+            chunk_size = len(self.reports)
+        t0 = time.perf_counter()
+        if chunk_size is None:
+            batch = bm.marshal_reports(self.reports)
+            result = run_round(bm, self.verify_key, self.ctx,
+                               agg_param, batch, self.reports,
+                               metrics_out=self.metrics)
+        else:
+            result = _run_round_chunked(
+                bm, self.verify_key, self.ctx, agg_param,
+                self.reports, chunk_size, self.metrics,
+                mesh=self.mesh)
+        if self.metrics:
+            self.metrics[-1].extra["round_wall_ms"] = round(
+                (time.perf_counter() - t0) * 1e3, 2)
+        self._result = list(zip(self.attributes, result))
+        self.done = True
+        return False
+
+    def result(self) -> list:
+        return self._result
+
+    def frontier(self) -> list:
+        """Truncated output: the full result once the one round ran,
+        nothing before (no partial claims exist for a single-round
+        mode)."""
+        return list(self._result) if self.done else []
+
+    def rounds_completed(self) -> int:
+        return 1 if self.done else 0
+
+    # -- checkpoint / resume (service snapshot hooks) --------------
+
+    def to_bytes(self) -> bytes:
+        return json.dumps({
+            "done": self.done,
+            "result": (None if self._result is None
+                       else [[a, v] for (a, v) in self._result]),
+        }).encode()
+
+    @classmethod
+    def from_bytes(cls, mastic: Mastic, ctx: bytes,
+                   attributes: Sequence[str], reports: list,
+                   verify_key: bytes, data: bytes,
+                   chunk_size: Optional[int] = None,
+                   mesh=None) -> "AttributeMetricsRun":
+        run = cls(mastic, ctx, attributes, reports,
+                  verify_key=verify_key, chunk_size=chunk_size,
+                  mesh=mesh)
+        state = json.loads(data)
+        if state["done"]:
+            run.done = True
+            run._result = [(a, v) for (a, v) in state["result"]]
+        return run
 
 
 def _round_fn_masked(bm: BatchedMastic, ctx: bytes, agg_param, mesh):

@@ -28,7 +28,12 @@ deterministic and replayable.  Step names are the wire labels of
 drivers/parties.py (hello, leader_port, upload, upload_report,
 upload_ack, agg_param, prep_share, resolution, agg_share, shutdown)
 plus the process checkpoints (spawn, reports_loaded, round_start,
-prep_done, resolve_done, confirm_done).
+prep_done, resolve_done, confirm_done) and the collector service's
+ingest/scheduler checkpoints (drivers/service.py, party=collector:
+admit, page_flush, epoch_start, epoch_round, snapshot — page_flush
+additionally honors truncate/corrupt as a content mutation of the
+sealed page's stored bytes, modeling storage corruption the page
+digest must catch).
 
 Each process parses `MASTIC_FAULTS` itself and keeps only the rules
 addressed to its own party name, so one env var arms the whole
@@ -181,6 +186,35 @@ class FaultInjector:
             time.sleep(HANG_SECONDS)
         elif rule.action == "delay":
             time.sleep(rule.delay)
+
+    def on_blob(self, step: str, blob: bytes) -> bytes:
+        """Combined checkpoint + content seam for a blob-producing
+        step (the service's `page_flush`): ONE (party, step) event,
+        so a rule's `nth` counts seals, not internal hook calls.
+        kill/hang/delay fire as process faults; truncate/corrupt
+        mutate the produced bytes (modeling storage corruption —
+        applied AFTER the caller's digest, which must catch it)."""
+        rule = self._match(step)
+        if rule is None:
+            return blob
+        if rule.action == "kill":
+            os._exit(KILL_EXIT_CODE)
+        if rule.action == "hang":
+            time.sleep(HANG_SECONDS)
+            return blob
+        if rule.action == "delay":
+            time.sleep(rule.delay)
+            return blob
+        if rule.action == "truncate":
+            return blob[:max(0, len(blob) - rule.cut)]
+        if rule.action == "corrupt":
+            off = min(rule.offset, len(blob) - 1)
+            mutated = bytearray(blob)
+            mutated[off] ^= (rule.xor or 0x01)
+            return bytes(mutated)
+        raise ValueError(
+            f"fault action {rule.action!r} does not apply to "
+            f"step {step!r}")
 
     def split_report_blob(self, step: str, blob: bytes) -> bytes:
         """Content-level mutation of ONE report blob inside the upload

@@ -380,6 +380,30 @@ class HeavyHittersRun:
     def result(self) -> list:
         return self.heavy_hitters
 
+    def frontier(self) -> list:
+        """The truncated-but-correct output after the last COMPLETED
+        level (the collector service's deadline-degradation contract,
+        drivers/service.py): the prefixes that passed every completed
+        round's threshold.  A finished run's frontier IS its result;
+        a run cut off mid-tree reports the survivors of the last
+        completed level (recovered as the unique parents of the
+        expanded candidate set — step() expands survivors into their
+        children before returning).  Nothing is claimed about levels
+        that never ran."""
+        if self.done:
+            return list(self.heavy_hitters)
+        if self.level == 0:
+            return []   # no round completed: nothing verified yet
+        seen: dict = {}
+        for p in self.prefixes:
+            seen.setdefault(p[:-1], None)
+        return list(seen)
+
+    def rounds_completed(self) -> int:
+        """Levels completed over the run's lifetime (survives
+        checkpoint-resume; `metrics` only covers this process)."""
+        return self.level
+
     # -- checkpoint / resume ---------------------------------------
 
     def to_bytes(self) -> bytes:

@@ -842,6 +842,11 @@ class ProcessCollector:
 
     def _send_upload(self) -> None:
         cfg = self.config
+        # The whole retry ladder shares one round-deadline budget:
+        # with_retries clamps each backoff sleep to what remains and
+        # fails fast (attributed) once it is gone, so a retried
+        # upload cannot overrun the round budget by the backoff.
+        deadline = Deadline(cfg.round_deadline)
 
         def attempt():
             self.quarantine = {}
@@ -861,7 +866,7 @@ class ProcessCollector:
                 raise self._attributed(err)
 
         with_retries(attempt, cfg.retries, cfg.backoff,
-                     on_retry=self._on_retry)
+                     on_retry=self._on_retry, deadline=deadline)
         self.counters["quarantined"] = len(self.quarantine)
         if len(self.quarantine) >= self.num_reports \
                 and self.num_reports > 0:

@@ -1,0 +1,939 @@
+"""Continuous-ingest collector service: admission control,
+backpressure, and supervised multi-tenant epochs (ROADMAP open item 1).
+
+Every driver below this layer runs one offline batch; production
+Mastic is a *stream* of uploads hitting a long-lived collector that
+must stay up through malformed reports, slow tenants, overload, and
+process crashes.  This module is that collector:
+
+* **paged report buffers** — admitted uploads append to fixed-size
+  pages (`ReportPage`; the ragged tail page seals at epoch cut), so
+  admission is O(1) per upload and an epoch's report set is a list of
+  immutable pages whose integrity is digest-checked before any page
+  feeds a round (the PAPERS.md "Ragged Paged Attention" shape:
+  fixed-size pages, ragged tails, admission while rounds are in
+  flight);
+
+* **admission control** — every upload blob is decode-validated at
+  the door against BOTH parties' views; a malformed blob quarantines
+  with the r8 reason codes (`drivers/parties.REASON_*`), and a tenant
+  whose quarantine count passes its limit is suspended (its later
+  uploads shed with reason ``tenant-quarantined``) so one abusive
+  tenant cannot starve the rest;
+
+* **backpressure, never silent** — per-tenant buffered reports are
+  bounded (`MASTIC_SERVICE_MAX_BUFFERED`); an over-quota upload is
+  shed under an explicit policy (`MASTIC_SERVICE_SHED_POLICY`):
+  ``reject-newest`` refuses the incoming upload, ``oldest-epoch-first``
+  drops the oldest *pending* (not yet running) epoch to make room.
+  Every shed lands in `ServiceCounters.shed_reasons`;
+
+* **epoch scheduler** — `begin_epoch` seals the tenant's buffered
+  pages into an epoch; `step()` runs ONE round of one tenant's active
+  epoch and round-robins across tenants, so many collection instances
+  (Count / Histogram / SumVec at different bit-widths) multiplex
+  through the one pipelined executor while admission continues.  The
+  scheduler drives every tenant through the `CollectionRun` interface
+  (heavy-hitters multi-round, attribute-metrics single-round — the
+  DrJAX map/reduce shape: one `step` maps a round over the report
+  axis, the aggregate is the reduce);
+
+* **deadlines with graceful degradation** — each epoch gets a
+  `Deadline` (`MASTIC_SERVICE_EPOCH_DEADLINE`, defaulting to the r8
+  `MASTIC_ROUND_DEADLINE` lever); an epoch that blows it finishes at
+  the last completed level and reports the truncated-but-correct
+  frontier (`CollectionRun.frontier()`), marked ``truncated`` in its
+  result record — degraded output over silent overrun;
+
+* **supervision** — a round that raises is caught, counted, and
+  retried a bounded number of times before the epoch is failed; the
+  service keeps serving its other tenants either way;
+
+* **crash-resume** — `to_bytes()` extends the r8 snapshot format
+  (length-prefixed JSON binding header + npz payload) to cover
+  buffered-but-unaggregated pages, queued and active epochs (the
+  active run's own checkpoint blob rides inside), and every counter;
+  `from_bytes()` restores a service that continues bit-identically
+  (pages hold the original upload bytes, and the runs' checkpoint
+  machinery is the r5/r8 bit-identity-proven one).  A restored
+  epoch's deadline restarts fresh: the budget bounds compute per
+  process lifetime, not across crashes.
+
+Fault injection (`MASTIC_FAULTS`, party ``collector``) plugs in at
+the ingest seams: checkpoint ``admit`` fires per admission attempt
+(kill / hang / delay), checkpoint ``page_flush`` fires per page seal
+and its ``corrupt`` / ``truncate`` actions mutate the sealed page's
+stored bytes AFTER the digest is taken — modeling storage corruption,
+which the digest check must catch — and checkpoints ``epoch_start`` /
+``epoch_round`` / ``snapshot`` fire in the scheduler.
+"""
+
+import abc
+import hashlib
+import json
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from .. import wire
+from ..metrics import ServiceCounters
+from . import faults as faults_mod
+from .session import Deadline, _env_float, _env_int
+from .parties import (REASON_MALFORMED, REASON_NAMES, REASON_RANGE,
+                      instantiate)
+from .attribute_metrics import AttributeMetricsRun
+from .heavy_hitters import HeavyHittersRun
+
+# Page-integrity failure: the page's stored bytes no longer match the
+# digest taken at seal time (storage corruption; the `page_flush`
+# fault models it).  Extends the r8 per-report reason codes.
+REASON_PAGE_CORRUPT = 3
+SERVICE_REASON_NAMES = dict(REASON_NAMES)
+SERVICE_REASON_NAMES[REASON_PAGE_CORRUPT] = "page-corrupt"
+
+SHED_POLICIES = ("reject-newest", "oldest-epoch-first")
+
+# submit() outcomes.
+ADMITTED = "admitted"
+QUARANTINED = "quarantined"
+SHED = "shed"
+
+_SNAPSHOT_VERSION = 1
+
+
+# -- the scheduler-facing run interface -------------------------------
+
+class CollectionRun(abc.ABC):
+    """What the epoch scheduler needs from a collection run — the one
+    interface the heavy-hitters multi-round loop, the chunked
+    streaming loop (both via `HeavyHittersRun`), and the
+    attribute-metrics single round (`AttributeMetricsRun`) all stand
+    behind.  `HeavyHittersRun` predates this ABC and is registered as
+    a virtual subclass; its checkpoint machinery is the bit-identity
+    contract the service snapshot rides on.
+    """
+
+    done: bool
+    metrics: list
+
+    @abc.abstractmethod
+    def step(self) -> bool:
+        """Run one round; True while more rounds remain."""
+
+    @abc.abstractmethod
+    def result(self):
+        """The collection's final output (valid once `done`)."""
+
+    @abc.abstractmethod
+    def frontier(self) -> list:
+        """The truncated-but-correct output after the last COMPLETED
+        round — what a deadline-missed epoch reports.  Every entry
+        passed all checks of every completed round; nothing about
+        rounds that never ran is claimed."""
+
+    @abc.abstractmethod
+    def rounds_completed(self) -> int:
+        """Rounds completed over the run's LIFETIME — unlike
+        `len(metrics)`, this survives checkpoint-resume (the metrics
+        list only covers rounds run in this process)."""
+
+    @abc.abstractmethod
+    def to_bytes(self) -> bytes:
+        """Checkpoint between rounds (resume must be bit-identical)."""
+
+
+CollectionRun.register(HeavyHittersRun)
+CollectionRun.register(AttributeMetricsRun)
+
+MODES = ("heavy_hitters", "attribute_metrics")
+
+
+# -- configuration ----------------------------------------------------
+
+def _env_str(name: str, default: str) -> str:
+    import os
+
+    raw = os.environ.get(name)
+    return default if raw is None or not raw.strip() else raw.strip()
+
+
+@dataclass
+class ServiceConfig:
+    """Service-wide levers (env forms in USAGE.md "Collector
+    service").  Per-tenant overrides live on `TenantSpec`."""
+
+    page_size: int = 64           # reports per buffer page
+    max_buffered: int = 4096      # per-tenant admitted-but-unfinished
+    max_pending_epochs: int = 4   # per-tenant queued (not running)
+    shed_policy: str = "reject-newest"
+    quarantine_limit: int = 64    # per-tenant; past it, suspend
+    epoch_deadline: float = 1800.0
+    epoch_retries: int = 1        # extra attempts for a failing round
+
+    def __post_init__(self):
+        if self.shed_policy not in SHED_POLICIES:
+            raise ValueError(
+                f"unknown shed policy {self.shed_policy!r} (must be "
+                f"one of {', '.join(SHED_POLICIES)})")
+        if self.page_size < 1:
+            raise ValueError("page_size must be >= 1")
+
+    @classmethod
+    def from_env(cls) -> "ServiceConfig":
+        return cls(
+            page_size=_env_int("MASTIC_SERVICE_PAGE_SIZE", 64),
+            max_buffered=_env_int("MASTIC_SERVICE_MAX_BUFFERED", 4096),
+            max_pending_epochs=_env_int("MASTIC_SERVICE_MAX_EPOCHS", 4),
+            shed_policy=_env_str("MASTIC_SERVICE_SHED_POLICY",
+                                 "reject-newest"),
+            quarantine_limit=_env_int("MASTIC_SERVICE_QUARANTINE_LIMIT",
+                                      64),
+            epoch_deadline=_env_float(
+                "MASTIC_SERVICE_EPOCH_DEADLINE",
+                _env_float("MASTIC_ROUND_DEADLINE", 1800.0)),
+            epoch_retries=_env_int("MASTIC_SERVICE_EPOCH_RETRIES", 1),
+        )
+
+
+@dataclass
+class TenantSpec:
+    """One collection instance (tenant) the service multiplexes.
+
+    `spec` is the r8 party-config instantiation record
+    ({"class": "MasticCount", "args": [8]}); `mode` picks the run
+    kind; `thresholds` (heavy hitters) / `attributes` (attribute
+    metrics) parameterize it.  Optional overrides fall back to the
+    service config."""
+
+    name: str
+    spec: dict
+    ctx: bytes
+    verify_key: bytes
+    mode: str = "heavy_hitters"
+    thresholds: Optional[dict] = None
+    attributes: Optional[list] = None
+    chunk_size: Optional[int] = None
+    page_size: Optional[int] = None
+    max_buffered: Optional[int] = None
+    epoch_deadline: Optional[float] = None
+    quarantine_limit: Optional[int] = None
+
+    def __post_init__(self):
+        if self.mode not in MODES:
+            raise ValueError(f"unknown tenant mode {self.mode!r} "
+                             f"(must be one of {', '.join(MODES)})")
+        if self.mode == "heavy_hitters" and not self.thresholds:
+            raise ValueError(f"tenant {self.name}: heavy_hitters mode "
+                             f"needs thresholds")
+        if self.mode == "attribute_metrics" and not self.attributes:
+            raise ValueError(f"tenant {self.name}: attribute_metrics "
+                             f"mode needs attributes")
+
+    def to_json(self) -> dict:
+        return {
+            "name": self.name, "spec": self.spec,
+            "ctx": self.ctx.hex(), "verify_key": self.verify_key.hex(),
+            "mode": self.mode,
+            "thresholds": (None if self.thresholds is None
+                           else thresholds_to_json(self.thresholds)),
+            "attributes": self.attributes,
+            "chunk_size": self.chunk_size,
+            "page_size": self.page_size,
+            "max_buffered": self.max_buffered,
+            "epoch_deadline": self.epoch_deadline,
+            "quarantine_limit": self.quarantine_limit,
+        }
+
+    @classmethod
+    def from_json(cls, data: dict) -> "TenantSpec":
+        return cls(
+            name=data["name"], spec=data["spec"],
+            ctx=bytes.fromhex(data["ctx"]),
+            verify_key=bytes.fromhex(data["verify_key"]),
+            mode=data["mode"],
+            thresholds=(None if data["thresholds"] is None
+                        else thresholds_from_json(data["thresholds"])),
+            attributes=data["attributes"],
+            chunk_size=data["chunk_size"],
+            page_size=data["page_size"],
+            max_buffered=data["max_buffered"],
+            epoch_deadline=data["epoch_deadline"],
+            quarantine_limit=data["quarantine_limit"],
+        )
+
+
+def thresholds_to_json(thresholds: dict) -> dict:
+    """Prefix-tuple keys -> bit strings ("default" passes through)."""
+    out = {}
+    for (k, v) in thresholds.items():
+        if k == "default":
+            out[k] = v
+        else:
+            out["".join("1" if b else "0" for b in k)] = v
+    return out
+
+
+def thresholds_from_json(data: dict) -> dict:
+    out = {}
+    for (k, v) in data.items():
+        if k == "default":
+            out[k] = v
+        else:
+            out[tuple(c == "1" for c in k)] = v
+    return out
+
+
+# -- upload codec (both parties' views in one blob) -------------------
+
+def encode_upload(mastic, report) -> bytes:
+    """One client upload as the service ingests it: both aggregators'
+    wire-encoded views, framed back to back (clients talk to the
+    aggregators directly in a full deployment; the service here is
+    the ingest door of the co-located pair)."""
+    (nonce, public_share, input_shares) = report
+    return (wire.frame(wire.encode_report(mastic, 0, nonce,
+                                          public_share,
+                                          input_shares[0]))
+            + wire.frame(wire.encode_report(mastic, 1, nonce,
+                                            public_share,
+                                            input_shares[1])))
+
+
+def decode_upload(mastic, blob: bytes) -> tuple:
+    """Validate + decode one upload blob into the drivers' report
+    tuple.  Raises ValueError on any malformation — the admission
+    path turns that into a reason-coded quarantine."""
+    (b0, rest) = wire.unframe(blob)
+    (b1, rest) = wire.unframe(rest)
+    if rest:
+        raise ValueError(f"{len(rest)} trailing bytes after the "
+                         f"helper view")
+    (nonce0, ps0, share0) = wire.decode_report(mastic, 0, b0)
+    (nonce1, _ps1, share1) = wire.decode_report(mastic, 1, b1)
+    if nonce0 != nonce1:
+        raise ValueError("nonce mismatch between the party views")
+    head = mastic.NONCE_SIZE + wire.public_share_size(mastic)
+    if b0[:head] != b1[:head]:
+        raise ValueError("public share mismatch between the party "
+                         "views")
+    return (nonce0, ps0, [share0, share1])
+
+
+def _decode_reason(exc: Exception) -> int:
+    """The r8 reason taxonomy (drivers/parties.load_reports)."""
+    return (REASON_RANGE if "out of range" in str(exc)
+            else REASON_MALFORMED)
+
+
+# -- paged report buffers ---------------------------------------------
+
+class ReportPage:
+    """A fixed-size page of admitted upload blobs.  Open pages accept
+    appends; `seal()` freezes the page behind a SHA-256 digest of its
+    framed payload, verified every time the page's bytes feed a round
+    or cross a snapshot — a corrupted page is detected and dropped,
+    never silently aggregated."""
+
+    __slots__ = ("blobs", "count", "payload", "digest")
+
+    def __init__(self):
+        self.blobs: list = []
+        self.count = 0
+        self.payload: Optional[bytes] = None
+        self.digest: Optional[bytes] = None
+
+    def append(self, blob: bytes) -> None:
+        if self.payload is not None:
+            raise ValueError("page is sealed")
+        self.blobs.append(blob)
+        self.count += 1
+
+    def seal(self) -> None:
+        if self.payload is not None:
+            return
+        self.payload = b"".join(wire.frame(b) for b in self.blobs)
+        self.digest = hashlib.sha256(self.payload).digest()
+        self.blobs = []
+
+    def verify(self) -> bool:
+        if self.payload is None:
+            return True   # open page: bytes never left this process
+        return hashlib.sha256(self.payload).digest() == self.digest
+
+    def decode_blobs(self) -> list:
+        """The page's upload blobs (sealed pages unframe their stored
+        payload; digest must be verified by the caller first)."""
+        if self.payload is None:
+            return list(self.blobs)
+        (out, rest) = ([], self.payload)
+        while rest:
+            (blob, rest) = wire.unframe(rest)
+            out.append(blob)
+        return out
+
+    @classmethod
+    def from_payload(cls, payload: bytes, digest: bytes,
+                     count: int) -> "ReportPage":
+        page = cls()
+        page.payload = payload
+        page.digest = digest
+        page.count = count
+        return page
+
+
+class _Epoch:
+    """One sealed collection epoch: the pages cut from the tenant's
+    buffer at begin_epoch, plus (once scheduled) the live run."""
+
+    __slots__ = ("epoch_id", "pages", "run", "reports", "deadline",
+                 "failures", "started_at", "reports_lost")
+
+    def __init__(self, epoch_id: int, pages: list):
+        self.epoch_id = epoch_id
+        self.pages = pages
+        self.run = None
+        self.reports: Optional[list] = None   # decoded at start
+        self.deadline: Optional[Deadline] = None
+        self.failures = 0
+        self.started_at: Optional[float] = None
+        self.reports_lost = 0   # dropped by page-corruption detection
+
+    def report_count(self) -> int:
+        return sum(p.count for p in self.pages)
+
+
+class _Tenant:
+    __slots__ = ("spec", "mastic", "open_page", "sealed", "pending",
+                 "active", "completed", "counters", "epoch_seq",
+                 "suspended")
+
+    def __init__(self, spec: TenantSpec):
+        self.spec = spec
+        self.mastic = instantiate(spec.spec)
+        self.open_page = ReportPage()
+        self.sealed: list = []      # sealed pages awaiting an epoch
+        self.pending: list = []     # [_Epoch] queued, oldest first
+        self.active: Optional[_Epoch] = None
+        self.completed: list = []   # epoch result records (dicts)
+        self.counters = ServiceCounters()
+        self.epoch_seq = 0
+        self.suspended = False
+
+    def buffered_reports(self) -> int:
+        """Reports the tenant holds admitted-but-unfinished — the
+        number the admission quota bounds (open + sealed pages,
+        queued epochs, and the running epoch)."""
+        total = self.open_page.count \
+            + sum(p.count for p in self.sealed) \
+            + sum(ep.report_count() for ep in self.pending)
+        if self.active is not None:
+            total += self.active.report_count()
+        return total
+
+
+# -- the service ------------------------------------------------------
+
+class CollectorService:
+    """The long-lived, supervised multi-tenant collector (module
+    docstring has the full story).  Single-threaded by design: one
+    `step()` is one scheduler quantum (one round of one tenant's
+    active epoch), and `submit()` may be called between quanta —
+    admission lands in the open page, so uploads arriving while
+    rounds are in flight join the NEXT epoch."""
+
+    def __init__(self, tenants: list, config: Optional[ServiceConfig]
+                 = None, injector=None, mesh=None):
+        self.config = config or ServiceConfig.from_env()
+        self.mesh = mesh
+        self.injector = (injector if injector is not None
+                         else faults_mod.injector_from_env("collector"))
+        self.tenants: dict = {}
+        for spec in tenants:
+            if spec.name in self.tenants:
+                raise ValueError(f"duplicate tenant {spec.name!r}")
+            self.tenants[spec.name] = _Tenant(spec)
+        self._rr = 0   # round-robin cursor over tenant order
+        self.resumed = False
+
+    # -- small config helpers --------------------------------------
+
+    def _page_size(self, t: _Tenant) -> int:
+        return t.spec.page_size or self.config.page_size
+
+    def _max_buffered(self, t: _Tenant) -> int:
+        return t.spec.max_buffered or self.config.max_buffered
+
+    def _quarantine_limit(self, t: _Tenant) -> int:
+        return (t.spec.quarantine_limit
+                if t.spec.quarantine_limit is not None
+                else self.config.quarantine_limit)
+
+    def _epoch_deadline(self, t: _Tenant) -> float:
+        return (t.spec.epoch_deadline
+                if t.spec.epoch_deadline is not None
+                else self.config.epoch_deadline)
+
+    def _checkpoint(self, step: str) -> None:
+        if self.injector is not None:
+            self.injector.checkpoint(step)
+
+    # -- admission -------------------------------------------------
+
+    def submit(self, tenant: str, blob: bytes) -> tuple:
+        """Admit one upload blob for `tenant`.  Returns (status,
+        detail): ADMITTED, QUARANTINED (detail = reason name), or
+        SHED (detail = policy / reason).  Never raises for bad input
+        — a hostile upload must cost the service one decode, not an
+        exception path."""
+        t = self.tenants[tenant]
+        self._checkpoint("admit")
+        if t.suspended:
+            t.counters.shed += 1
+            t.counters.bump_shed("tenant-quarantined")
+            return (SHED, "tenant-quarantined")
+        try:
+            decode_upload(t.mastic, blob)
+        except (ValueError, EOFError) as exc:
+            reason = _decode_reason(exc)
+            t.counters.quarantined += 1
+            t.counters.bump_quarantine(SERVICE_REASON_NAMES[reason])
+            if t.counters.quarantined >= self._quarantine_limit(t):
+                t.suspended = True
+            return (QUARANTINED, SERVICE_REASON_NAMES[reason])
+        if t.buffered_reports() >= self._max_buffered(t):
+            # oldest-epoch-first may make room by dropping a queued
+            # epoch; if the buffer is still over quota after that (or
+            # the policy is reject-newest), the incoming upload sheds.
+            self._shed(t)
+            if t.buffered_reports() >= self._max_buffered(t):
+                t.counters.shed += 1
+                t.counters.bump_shed("reject-newest")
+                return (SHED, "reject-newest")
+        t.open_page.append(blob)
+        t.counters.admitted += 1
+        if t.open_page.count >= self._page_size(t):
+            self._seal_open_page(t)
+        return (ADMITTED, "")
+
+    def _shed(self, t: _Tenant) -> Optional[str]:
+        """Over-quota relief under the configured policy.  Returns the
+        shed detail when room was made (oldest-epoch-first), None when
+        the incoming upload itself must be rejected."""
+        if self.config.shed_policy != "oldest-epoch-first" \
+                or not t.pending:
+            return None
+        victim = t.pending.pop(0)
+        lost = victim.report_count()
+        t.counters.shed += lost
+        t.counters.bump_shed("oldest-epoch-first", lost)
+        return f"oldest-epoch-first dropped epoch {victim.epoch_id} " \
+               f"({lost} reports)"
+
+    def _seal_open_page(self, t: _Tenant) -> None:
+        page = t.open_page
+        t.open_page = ReportPage()
+        page.seal()
+        if self.injector is not None:
+            # One fault event per seal: kill/hang/delay fire as
+            # process faults, truncate/corrupt mutate the stored
+            # bytes AFTER the digest (storage-corruption model — the
+            # verify() gate must catch it downstream).
+            page.payload = self.injector.on_blob("page_flush",
+                                                 page.payload)
+        t.sealed.append(page)
+        t.counters.pages_sealed += 1
+
+    # -- epochs ----------------------------------------------------
+
+    def begin_epoch(self, tenant: str) -> Optional[int]:
+        """Cut the tenant's buffered pages into a new pending epoch.
+        Returns the epoch id, or None when there is nothing buffered
+        or the pending queue is full under reject-newest (the pages
+        stay buffered for a later cut)."""
+        t = self.tenants[tenant]
+        if t.open_page.count:
+            self._seal_open_page(t)
+        if not t.sealed:
+            return None
+        if len(t.pending) >= self.config.max_pending_epochs:
+            if self._shed(t) is None:
+                # reject-newest: the cut is refused (pages stay
+                # buffered for a later attempt), counted, not silent.
+                t.counters.epochs_refused += 1
+                return None
+        epoch = _Epoch(t.epoch_seq, t.sealed)
+        t.epoch_seq += 1
+        t.sealed = []
+        t.pending.append(epoch)
+        return epoch.epoch_id
+
+    def _build_run(self, t: _Tenant, reports: list) -> CollectionRun:
+        spec = t.spec
+        if spec.mode == "heavy_hitters":
+            return HeavyHittersRun(
+                t.mastic, spec.ctx, spec.thresholds, reports,
+                verify_key=spec.verify_key,
+                chunk_size=spec.chunk_size, mesh=self.mesh)
+        return AttributeMetricsRun(
+            t.mastic, spec.ctx, spec.attributes, reports,
+            verify_key=spec.verify_key, chunk_size=spec.chunk_size,
+            mesh=self.mesh)
+
+    def _restore_run(self, t: _Tenant, reports: list,
+                     blob: bytes) -> CollectionRun:
+        spec = t.spec
+        if spec.mode == "heavy_hitters":
+            return HeavyHittersRun.from_bytes(
+                t.mastic, spec.ctx, spec.thresholds, reports,
+                spec.verify_key, blob, mesh=self.mesh)
+        return AttributeMetricsRun.from_bytes(
+            t.mastic, spec.ctx, spec.attributes, reports,
+            spec.verify_key, blob, chunk_size=spec.chunk_size,
+            mesh=self.mesh)
+
+    def _epoch_reports(self, t: _Tenant, epoch: _Epoch) -> list:
+        """Decode the epoch's pages into the drivers' report tuples,
+        dropping (and counting) any page whose digest check fails —
+        a corrupted page degrades the epoch, never poisons it."""
+        reports = []
+        surviving = []
+        for page in epoch.pages:
+            if not page.verify():
+                epoch.reports_lost += page.count
+                t.counters.pages_corrupt += 1
+                t.counters.quarantined += page.count
+                t.counters.bump_quarantine(
+                    SERVICE_REASON_NAMES[REASON_PAGE_CORRUPT],
+                    page.count)
+                continue
+            surviving.append(page)
+            for blob in page.decode_blobs():
+                # Admission already validated the blob; decode again
+                # so the run consumes exactly the persisted bytes.
+                reports.append(decode_upload(t.mastic, blob))
+        epoch.pages = surviving
+        return reports
+
+    def _start_epoch(self, t: _Tenant) -> None:
+        epoch = t.pending.pop(0)
+        self._checkpoint("epoch_start")
+        reports = self._epoch_reports(t, epoch)
+        if not reports:
+            # Every page was corrupt (or the epoch was empty): an
+            # immediately-final degraded epoch, counted, not raised.
+            t.counters.epochs_started += 1
+            t.counters.epochs_failed += 1
+            t.completed.append(self._record(t, epoch, result=[],
+                                            truncated=True,
+                                            levels=0, error="no "
+                                            "surviving reports"))
+            return
+        epoch.reports = reports
+        t.counters.epochs_started += 1
+        try:
+            epoch.run = self._build_run(t, reports)
+        except Exception as exc:
+            # Run construction can refuse (e.g. a memory-envelope
+            # gate for the tenant's chunk config): a config-sick
+            # tenant fails ITS epoch, attributably — not the service.
+            t.counters.epochs_failed += 1
+            t.completed.append(self._record(
+                t, epoch, result=[], truncated=True, levels=0,
+                error=f"{type(exc).__name__}: {exc}"))
+            return
+        epoch.deadline = Deadline(self._epoch_deadline(t))
+        epoch.started_at = time.monotonic()
+        t.active = epoch
+
+    def _record(self, t: _Tenant, epoch: _Epoch, result,
+                truncated: bool, levels: int,
+                error: Optional[str] = None) -> dict:
+        rec = {
+            "tenant": t.spec.name,
+            "epoch": epoch.epoch_id,
+            "reports": epoch.report_count(),
+            "reports_lost": epoch.reports_lost,
+            "result": _jsonable(result),
+            "truncated": truncated,
+            "levels_completed": levels,
+        }
+        if epoch.started_at is not None:
+            rec["wall_s"] = round(time.monotonic() - epoch.started_at,
+                                  3)
+        if error is not None:
+            rec["error"] = error
+        return rec
+
+    # -- the scheduler ---------------------------------------------
+
+    def step(self) -> bool:
+        """One scheduler quantum: pick the next tenant (round-robin)
+        with work, run one round of its active epoch (starting the
+        oldest pending epoch if none is active), and return whether
+        any tenant still has epoch work queued or running."""
+        names = list(self.tenants)
+        for off in range(len(names)):
+            t = self.tenants[names[(self._rr + off) % len(names)]]
+            if t.active is None and t.pending:
+                self._start_epoch(t)
+            if t.active is None:
+                continue
+            self._rr = (self._rr + off + 1) % len(names)
+            self._run_one_round(t)
+            break
+        return any(t.active is not None or t.pending
+                   for t in self.tenants.values())
+
+    def _run_one_round(self, t: _Tenant) -> None:
+        epoch = t.active
+        self._checkpoint("epoch_round")
+        if epoch.deadline.expired():
+            # Graceful degradation: finish at the last completed
+            # level; the frontier is correct for every round that ran.
+            t.counters.deadline_misses += 1
+            t.counters.epochs_truncated += 1
+            t.completed.append(self._record(
+                t, epoch, result=epoch.run.frontier(),
+                truncated=True,
+                levels=epoch.run.rounds_completed()))
+            t.active = None
+            return
+        t0 = time.perf_counter()
+        before = len(epoch.run.metrics)
+        try:
+            more = epoch.run.step()
+        except Exception as exc:   # supervised: fail the epoch, not
+            # the service — other tenants keep their schedule
+            epoch.failures += 1
+            if epoch.failures > self.config.epoch_retries:
+                t.counters.epochs_failed += 1
+                t.completed.append(self._record(
+                    t, epoch, result=epoch.run.frontier(),
+                    truncated=True,
+                    levels=epoch.run.rounds_completed(),
+                    error=f"{type(exc).__name__}: {exc}"))
+                t.active = None
+            else:
+                # A round that raises mid-execution can leave the
+                # runner's device carries inconsistent, so the retry
+                # REBUILDS the run from the epoch's pages — prep is a
+                # pure function of the reports, so the restart is
+                # bit-identical (completed levels recompute; the r8
+                # respawn-and-replay model applied in-process).
+                epoch.run = self._build_run(t, epoch.reports)
+            return
+        t.counters.rounds += 1
+        quantum_ms = (time.perf_counter() - t0) * 1e3
+        for mx in epoch.run.metrics[before:]:
+            round_ms = mx.extra.get("round_wall_ms", 0.0)
+            mx.extra["service"] = {
+                "tenant": t.spec.name,
+                "epoch": epoch.epoch_id,
+                "sched_overhead_ms": round(
+                    max(0.0, quantum_ms - round_ms), 3),
+                "buffered_reports": t.buffered_reports(),
+                "pending_epochs": len(t.pending),
+            }
+        if not more:
+            t.counters.epochs_completed += 1
+            t.completed.append(self._record(
+                t, epoch, result=epoch.run.result(), truncated=False,
+                levels=epoch.run.rounds_completed()))
+            t.active = None
+
+    def run_until_drained(self,
+                          deadline: Optional[Deadline] = None) -> bool:
+        """Drive the scheduler until no epoch work remains.  Returns
+        False when `deadline` expired first (remaining work stays
+        queued — snapshot and resume, or keep stepping)."""
+        while self.step():
+            if deadline is not None and deadline.expired():
+                return False
+        return True
+
+    def drained(self) -> bool:
+        return not any(t.active is not None or t.pending
+                       for t in self.tenants.values())
+
+    # -- observability ---------------------------------------------
+
+    def metrics(self) -> dict:
+        """The service metrics JSON: per-tenant counters, buffer
+        occupancy, quarantine/shed reason tables, epoch records."""
+        out = {"policy": self.config.shed_policy,
+               "resumed": self.resumed, "tenants": {}}
+        for (name, t) in self.tenants.items():
+            out["tenants"][name] = {
+                "buffered_reports": t.buffered_reports(),
+                "open_page": t.open_page.count,
+                "sealed_pages": len(t.sealed),
+                "pending_epochs": len(t.pending),
+                "active_epoch": (t.active.epoch_id
+                                 if t.active is not None else None),
+                "suspended": t.suspended,
+                "counters": t.counters.as_dict(),
+                "epochs": list(t.completed),
+            }
+        return out
+
+    # -- snapshot / resume -----------------------------------------
+
+    def to_bytes(self) -> bytes:
+        """Snapshot everything a crash must not lose: buffered pages
+        (open + sealed), queued epochs, the active epoch's pages and
+        its run checkpoint, completed results, and counters — the r8
+        snapshot format (length-prefixed JSON binding header + npz
+        payload), extended to the ingest layer."""
+        import io
+
+        self._checkpoint("snapshot")
+        header = json.dumps({
+            "version": _SNAPSHOT_VERSION,
+            "policy": self.config.shed_policy,
+            "tenants": [t.spec.to_json()
+                        for t in self.tenants.values()],
+        }, sort_keys=True).encode()
+        data: dict = {"meta": np.array(
+            [_SNAPSHOT_VERSION, len(self.tenants)], np.int64)}
+
+        def put_page(prefix: str, page: ReportPage) -> None:
+            sealed = page.payload is not None
+            payload = (page.payload if sealed
+                       else b"".join(wire.frame(b)
+                                     for b in page.blobs))
+            data[prefix] = np.frombuffer(payload, np.uint8)
+            data[prefix + "_meta"] = np.array(
+                [page.count, int(sealed)], np.int64)
+            data[prefix + "_digest"] = np.frombuffer(
+                page.digest if sealed else b"\x00" * 32, np.uint8)
+
+        def put_epoch(prefix: str, epoch: _Epoch) -> None:
+            data[prefix + "_meta"] = np.array(
+                [epoch.epoch_id, len(epoch.pages),
+                 epoch.reports_lost], np.int64)
+            for (j, page) in enumerate(epoch.pages):
+                put_page(f"{prefix}_pg{j}", page)
+
+        for (i, t) in enumerate(self.tenants.values()):
+            data[f"t{i}_state"] = np.array(
+                [t.epoch_seq, int(t.suspended), len(t.sealed),
+                 len(t.pending), int(t.active is not None)], np.int64)
+            data[f"t{i}_counters"] = np.frombuffer(
+                json.dumps(t.counters.as_dict()).encode(), np.uint8)
+            data[f"t{i}_completed"] = np.frombuffer(
+                json.dumps(t.completed).encode(), np.uint8)
+            put_page(f"t{i}_open", t.open_page)
+            for (j, page) in enumerate(t.sealed):
+                put_page(f"t{i}_s{j}", page)
+            for (k, epoch) in enumerate(t.pending):
+                put_epoch(f"t{i}_p{k}", epoch)
+            if t.active is not None:
+                put_epoch(f"t{i}_active", t.active)
+                data[f"t{i}_active_run"] = np.frombuffer(
+                    t.active.run.to_bytes(), np.uint8)
+        buf = io.BytesIO()
+        np.savez(buf, **data)
+        return (len(header).to_bytes(4, "little") + header
+                + buf.getvalue())
+
+    @classmethod
+    def from_bytes(cls, data: bytes,
+                   config: Optional[ServiceConfig] = None,
+                   injector=None, mesh=None) -> "CollectorService":
+        """Restore a snapshotted service.  Page digests are verified
+        as epochs start (a snapshot corrupted in storage degrades the
+        affected epoch, detected, instead of aggregating garbage);
+        the active epoch's run resumes bit-identically from its own
+        checkpoint blob.  Its deadline restarts fresh — the budget
+        bounds compute per process lifetime."""
+        import io
+
+        hlen = int.from_bytes(data[:4], "little")
+        try:
+            header = json.loads(data[4:4 + hlen])
+        except ValueError:
+            raise ValueError(
+                "service snapshot has no JSON binding header — not a "
+                "snapshot written by CollectorService.to_bytes")
+        if header.get("version") != _SNAPSHOT_VERSION:
+            raise ValueError(f"unknown service snapshot version "
+                             f"{header.get('version')}")
+        arrays = np.load(io.BytesIO(data[4 + hlen:]),
+                         allow_pickle=False)
+        specs = [TenantSpec.from_json(d) for d in header["tenants"]]
+        if config is None:
+            config = ServiceConfig.from_env()
+        config.shed_policy = header["policy"]
+        svc = cls(specs, config=config, injector=injector, mesh=mesh)
+        svc.resumed = True
+
+        def get_page(prefix: str) -> ReportPage:
+            payload = arrays[prefix].tobytes()
+            (count, sealed) = [int(x)
+                               for x in arrays[prefix + "_meta"]]
+            digest = arrays[prefix + "_digest"].tobytes()
+            if sealed:
+                return ReportPage.from_payload(payload, digest, count)
+            page = ReportPage()
+            rest = payload
+            while rest:   # mastic-allow: RB005 — bounded by the
+                # stored open-page payload length
+                (blob, rest) = wire.unframe(rest)
+                page.append(blob)
+            return page
+
+        def get_epoch(prefix: str) -> _Epoch:
+            (epoch_id, npages, lost) = [
+                int(x) for x in arrays[prefix + "_meta"]]
+            epoch = _Epoch(epoch_id, [get_page(f"{prefix}_pg{j}")
+                                      for j in range(npages)])
+            epoch.reports_lost = lost
+            return epoch
+
+        for (i, t) in enumerate(svc.tenants.values()):
+            (seq, susp, nsealed, npending, has_active) = [
+                int(x) for x in arrays[f"t{i}_state"]]
+            t.epoch_seq = seq
+            t.suspended = bool(susp)
+            t.counters = ServiceCounters.from_dict(
+                json.loads(arrays[f"t{i}_counters"].tobytes()))
+            t.counters.resumes += 1
+            t.completed = json.loads(
+                arrays[f"t{i}_completed"].tobytes())
+            t.open_page = get_page(f"t{i}_open")
+            t.sealed = [get_page(f"t{i}_s{j}")
+                        for j in range(nsealed)]
+            t.pending = [get_epoch(f"t{i}_p{k}")
+                         for k in range(npending)]
+            if has_active:
+                epoch = get_epoch(f"t{i}_active")
+                reports = svc._epoch_reports(t, epoch)
+                if not reports:
+                    t.counters.epochs_failed += 1
+                    t.completed.append(svc._record(
+                        t, epoch, result=[], truncated=True,
+                        levels=0, error="no surviving reports after "
+                        "resume"))
+                else:
+                    epoch.reports = reports
+                    epoch.run = svc._restore_run(
+                        t, reports, arrays[f"t{i}_active_run"]
+                        .tobytes())
+                    epoch.deadline = Deadline(svc._epoch_deadline(t))
+                    epoch.started_at = time.monotonic()
+                    t.active = epoch
+        return svc
+
+
+def _jsonable(result):
+    """Epoch results as JSON-safe values (heavy-hitter prefixes are
+    bool tuples; attribute aggregates are (name, value) pairs)."""
+    if isinstance(result, (list, tuple)):
+        return [_jsonable(x) for x in result]
+    if isinstance(result, (bool, np.bool_)):
+        return bool(result)
+    if isinstance(result, (int, np.integer)):
+        return int(result)
+    return result

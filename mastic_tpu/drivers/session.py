@@ -307,11 +307,19 @@ def accept(server: socket.socket, remote: str, timeout: float,
 
 
 def with_retries(fn: Callable, attempts: int, backoff: float,
-                 on_retry: Optional[Callable] = None):
+                 on_retry: Optional[Callable] = None,
+                 deadline: Optional[Deadline] = None):
     """Run `fn()` with up to `attempts` retries on retryable
     SessionErrors, sleeping backoff * 2^i between attempts.
     `on_retry(err, attempt)` observes each retry (the metrics
-    counters hook in here)."""
+    counters hook in here).
+
+    With a `deadline`, the backoff sleep is clamped to the remaining
+    budget, and an exhausted budget fails fast with the last error's
+    attribution instead of sleeping through it — previously the loop
+    slept the FULL exponential backoff even when the deadline had
+    less remaining, so a caller's bounded operation could overrun
+    its budget by up to the whole backoff ladder."""
     attempt = 0
     while True:
         try:
@@ -319,7 +327,18 @@ def with_retries(fn: Callable, attempts: int, backoff: float,
         except SessionError as err:
             if not err.retryable() or attempt >= attempts:
                 raise
+            pause = backoff * (2 ** attempt)
+            if deadline is not None:
+                rem = deadline.remaining()
+                if rem is not None:
+                    if rem <= 0.0:
+                        raise SessionError(
+                            err.party, err.step, KIND_TIMEOUT,
+                            f"retry budget exhausted after "
+                            f"{attempt + 1} attempt(s); last error: "
+                            f"[{err.kind}] {err.detail}")
+                    pause = min(pause, rem)
             if on_retry is not None:
                 on_retry(err, attempt)
-            time.sleep(backoff * (2 ** attempt))
+            time.sleep(pause)
             attempt += 1

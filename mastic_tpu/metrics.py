@@ -18,7 +18,10 @@ Every aggregation round produces one `RoundMetrics` record with
   themselves conformance-locked).
 
 The drivers accumulate these per level; heavy-hitters exposes them as
-`HeavyHittersRun.metrics`.
+`HeavyHittersRun.metrics`.  The collector service
+(`drivers/service.py`) adds `ServiceCounters` — the per-tenant
+admission / backpressure / epoch ledger, with the same
+never-silent-degradation contract the r8 session counters set.
 """
 
 from dataclasses import asdict, dataclass, field
@@ -60,6 +63,48 @@ class RoundMetrics:
 
     def as_dict(self) -> dict:
         return asdict(self)
+
+
+@dataclass
+class ServiceCounters:
+    """Per-tenant ledger of the collector service's defensive
+    decisions (drivers/service.py).  Everything the service refuses,
+    drops, truncates, or retries lands here — backpressure and
+    degradation are surfaced, never silent.  `shed_reasons` /
+    `quarantine_reasons` break the totals down by policy / reason
+    name (the r8 reason-code taxonomy plus the service's
+    page-corrupt and tenant-quarantined entries)."""
+
+    admitted: int = 0
+    quarantined: int = 0         # reports refused at the door
+    shed: int = 0                # reports dropped by backpressure
+    pages_sealed: int = 0
+    pages_corrupt: int = 0       # digest-check failures (detected)
+    epochs_started: int = 0
+    epochs_completed: int = 0
+    epochs_truncated: int = 0    # deadline-missed, degraded output
+    epochs_failed: int = 0       # supervision gave up after retries
+    epochs_refused: int = 0      # begin_epoch hit the queue bound
+    deadline_misses: int = 0
+    rounds: int = 0              # scheduler quanta executed
+    resumes: int = 0             # snapshot restores of this tenant
+    quarantine_reasons: dict = field(default_factory=dict)
+    shed_reasons: dict = field(default_factory=dict)
+
+    def bump_quarantine(self, reason: str, n: int = 1) -> None:
+        self.quarantine_reasons[reason] = \
+            self.quarantine_reasons.get(reason, 0) + n
+
+    def bump_shed(self, reason: str, n: int = 1) -> None:
+        self.shed_reasons[reason] = \
+            self.shed_reasons.get(reason, 0) + n
+
+    def as_dict(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "ServiceCounters":
+        return cls(**data)
 
 
 def attribute_rejections(metrics: RoundMetrics, eval_proof_ok,

@@ -1,0 +1,16 @@
+"""Known-bad: unbounded buffer growth (RB004) — a capacity-less
+queue and an append loop with no bound or exit."""
+
+import collections
+import queue
+
+
+def make_buffers():
+    uploads = queue.Queue()            # no maxsize: unbounded
+    pages = collections.deque()        # no maxlen: unbounded
+    return (uploads, pages)
+
+
+def ingest_forever(source, buffered):
+    while True:
+        buffered.append(source.take())

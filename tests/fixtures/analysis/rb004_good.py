@@ -1,0 +1,21 @@
+"""Known-good: bounded buffers and a shed policy (RB004)."""
+
+import collections
+import queue
+
+MAX_BUFFERED = 4096
+
+
+def make_buffers():
+    uploads = queue.Queue(maxsize=MAX_BUFFERED)
+    pages = collections.deque(maxlen=64)
+    return (uploads, pages)
+
+
+def ingest_forever(source, buffered, counters):
+    while True:
+        blob = source.take()
+        if len(buffered) >= MAX_BUFFERED:
+            counters["shed"] += 1      # reject-newest, counted
+            continue
+        buffered.append(blob)

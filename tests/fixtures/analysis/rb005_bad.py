@@ -1,0 +1,14 @@
+"""Known-bad: a deadline-less scheduler loop (RB005) — nothing bounds
+the drain if an epoch wedges."""
+
+
+class EpochScheduler:
+    def __init__(self):
+        self.pending = []
+
+    def step(self) -> bool:
+        return bool(self.pending)
+
+    def run_until_drained(self) -> None:
+        while self.step():
+            pass

@@ -1,0 +1,15 @@
+"""Known-good: the scheduler loop threads a deadline (RB005)."""
+
+
+class EpochScheduler:
+    def __init__(self):
+        self.pending = []
+
+    def step(self) -> bool:
+        return bool(self.pending)
+
+    def run_until_drained(self, deadline) -> bool:
+        while self.step():
+            if deadline.expired():
+                return False
+        return True

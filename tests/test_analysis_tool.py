@@ -33,6 +33,8 @@ CASES = {
     "RB001": ("rb001_bad.py", "rb001_good.py", "robustness"),
     "RB002": ("rb002_bad.py", "rb002_good.py", "robustness"),
     "RB003": ("rb003_bad.py", "rb003_good.py", "robustness"),
+    "RB004": ("rb004_bad.py", "rb004_good.py", "robustness"),
+    "RB005": ("rb005_bad.py", "rb005_good.py", "robustness"),
 }
 
 

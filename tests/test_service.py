@@ -1,0 +1,630 @@
+"""Continuous-ingest collector service (ISSUE 6): admission control,
+backpressure, paged buffers, supervised multi-tenant epochs, and
+crash-resume through the service snapshot.
+
+Fast tier (run via `make serve-smoke`, wired into `make ci`; also in
+the plain fast suite): the host-side admission/backpressure/paging
+machinery (no device rounds), the upload-path fault checkpoints
+(hang during admission, corrupt page flush, kill during admission in
+a subprocess that dies before any compile), the with_retries
+deadline-clamp fix, and ONE end-to-end epoch proving the scheduler
+path bit-identical to the offline batch path including a mid-epoch
+snapshot/discard/resume.  Slow tier: the subprocess kill-9 +
+`--resume` pair through `tools/serve.py`, the two-tenant
+interleaving proof, the epoch-deadline degradation, and the
+mesh-sharded bit-identity.
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import time
+
+import pytest
+
+from mastic_tpu.common import gen_rand
+from mastic_tpu.drivers import faults
+from mastic_tpu.drivers.heavy_hitters import (
+    HeavyHittersRun, get_reports_from_measurements)
+from mastic_tpu.drivers.service import (ADMITTED, QUARANTINED, SHED,
+                                        CollectionRun,
+                                        CollectorService,
+                                        ServiceConfig, TenantSpec,
+                                        decode_upload, encode_upload,
+                                        thresholds_from_json,
+                                        thresholds_to_json)
+from mastic_tpu.drivers.session import (Deadline, SessionError,
+                                        with_retries)
+from mastic_tpu.mastic import MasticCount
+
+CTX = b"service test"
+COUNT2 = {"class": "MasticCount", "args": [2]}
+
+REPO = pathlib.Path(__file__).parent.parent
+
+
+def _reports(m, values, bits=2):
+    meas = [(m.vidpf.test_index_from_int(v, bits), True)
+            for v in values]
+    return get_reports_from_measurements(m, CTX, meas)
+
+
+def _spec(name="count", vk=None, m=None, **over):
+    m = m or MasticCount(2)
+    over.setdefault("thresholds", {"default": 2})
+    return TenantSpec(name=name, spec=COUNT2, ctx=CTX,
+                      verify_key=vk or gen_rand(m.VERIFY_KEY_SIZE),
+                      **over)
+
+
+def _cfg(**over):
+    base = dict(page_size=2, max_buffered=64, max_pending_epochs=4,
+                shed_policy="reject-newest", quarantine_limit=16,
+                epoch_deadline=600.0)
+    base.update(over)
+    return ServiceConfig(**base)
+
+
+def _admit(svc, tenant, m, reports):
+    return [svc.submit(tenant, encode_upload(m, r)) for r in reports]
+
+
+# -- with_retries deadline clamp (the r8 backoff bugfix) -------------
+
+def test_with_retries_clamps_sleep_to_deadline():
+    """A retry ladder whose backoff exceeds the remaining Deadline
+    budget must fail fast with attribution, not sleep through it
+    (previously it slept the FULL backoff regardless)."""
+    calls = []
+
+    def failing():
+        calls.append(1)
+        raise SessionError("helper", "upload", "timeout", "nope")
+
+    t0 = time.monotonic()
+    with pytest.raises(SessionError) as ei:
+        with_retries(failing, attempts=5, backoff=10.0,
+                     deadline=Deadline(0.3))
+    elapsed = time.monotonic() - t0
+    assert elapsed < 2.0, f"slept {elapsed:.1f}s past a 0.3s budget"
+    assert ei.value.kind == "timeout"
+    assert ei.value.party == "helper" and ei.value.step == "upload"
+    assert "retry budget exhausted" in ei.value.detail
+    assert len(calls) >= 2   # at least one clamped retry ran
+
+
+def test_with_retries_unbounded_keeps_old_behavior():
+    attempts = []
+
+    def failing():
+        attempts.append(1)
+        raise SessionError("helper", "upload", "timeout", "nope")
+
+    with pytest.raises(SessionError) as ei:
+        with_retries(failing, attempts=2, backoff=0.01)
+    assert len(attempts) == 3
+    assert ei.value.detail == "nope"   # the original error surfaces
+
+
+# -- upload codec + admission ----------------------------------------
+
+def test_upload_codec_roundtrip():
+    m = MasticCount(2)
+    report = _reports(m, [1])[0]
+    blob = encode_upload(m, report)
+    (nonce, _ps, shares) = decode_upload(m, blob)
+    assert nonce == report[0]
+    assert len(shares) == 2
+    with pytest.raises(ValueError):
+        decode_upload(m, blob + b"x")
+    with pytest.raises(ValueError):
+        decode_upload(m, blob[:-1])
+
+
+def test_malformed_uploads_quarantined_then_suspended():
+    m = MasticCount(2)
+    svc = CollectorService([_spec(quarantine_limit=3)],
+                           config=_cfg())
+    for (i, blob) in enumerate((b"", b"\x07garbage", b"\xff" * 40)):
+        (status, reason) = svc.submit("count", blob)
+        assert status == QUARANTINED
+        assert reason == "malformed"
+    # the limit hit: the tenant is suspended, later uploads shed
+    (status, reason) = svc.submit(
+        "count", encode_upload(m, _reports(m, [0])[0]))
+    assert (status, reason) == (SHED, "tenant-quarantined")
+    c = svc.metrics()["tenants"]["count"]
+    assert c["suspended"]
+    assert c["counters"]["quarantined"] == 3
+    assert c["counters"]["quarantine_reasons"] == {"malformed": 3}
+    assert c["counters"]["shed_reasons"] == {"tenant-quarantined": 1}
+
+
+def test_quota_reject_newest_and_page_seal():
+    m = MasticCount(2)
+    svc = CollectorService([_spec(max_buffered=3)],
+                           config=_cfg(page_size=2))
+    outcomes = _admit(svc, "count", m, _reports(m, [0] * 5))
+    assert [o[0] for o in outcomes] == \
+        [ADMITTED, ADMITTED, ADMITTED, SHED, SHED]
+    t = svc.metrics()["tenants"]["count"]
+    assert t["buffered_reports"] == 3      # bounded, not 5
+    assert t["sealed_pages"] == 1 and t["open_page"] == 1
+    assert t["counters"]["pages_sealed"] == 1
+    assert t["counters"]["shed_reasons"] == {"reject-newest": 2}
+
+
+def test_shed_oldest_epoch_first_makes_room():
+    m = MasticCount(2)
+    svc = CollectorService(
+        [_spec(max_buffered=4)],
+        config=_cfg(shed_policy="oldest-epoch-first"))
+    _admit(svc, "count", m, _reports(m, [0] * 4))
+    assert svc.begin_epoch("count") == 0
+    outcomes = _admit(svc, "count", m, _reports(m, [1] * 2))
+    assert [o[0] for o in outcomes] == [ADMITTED, ADMITTED]
+    t = svc.metrics()["tenants"]["count"]
+    assert t["pending_epochs"] == 0        # epoch 0 was dropped
+    assert t["counters"]["shed"] == 4
+    assert t["counters"]["shed_reasons"] == {"oldest-epoch-first": 4}
+
+
+def test_epoch_queue_bound_refuses_cut():
+    m = MasticCount(2)
+    svc = CollectorService([_spec()],
+                           config=_cfg(max_pending_epochs=1))
+    _admit(svc, "count", m, _reports(m, [0] * 2))
+    assert svc.begin_epoch("count") == 0
+    _admit(svc, "count", m, _reports(m, [1] * 2))
+    assert svc.begin_epoch("count") is None   # queue full, counted
+    t = svc.metrics()["tenants"]["count"]
+    assert t["counters"]["epochs_refused"] == 1
+    assert t["pending_epochs"] == 1
+    assert t["buffered_reports"] == 4         # pages stay buffered
+
+
+def test_empty_epoch_cut_is_none():
+    svc = CollectorService([_spec()], config=_cfg())
+    assert svc.begin_epoch("count") is None
+    assert svc.drained()
+
+
+# -- upload-path fault checkpoints (MASTIC_FAULTS extensions) --------
+
+def test_hang_during_admission_checkpoint_fires():
+    """`delay:party=collector:step=admit` stalls exactly one
+    admission — the in-process probe that the admit checkpoint is
+    wired (the kill flavor runs as a subprocess below)."""
+    m = MasticCount(2)
+    inj = faults.FaultInjector(
+        faults.parse_faults(
+            "delay:party=collector:step=admit:nth=2:delay=0.3"),
+        "collector")
+    svc = CollectorService([_spec()], config=_cfg(), injector=inj)
+    reports = _reports(m, [0, 1])
+    t0 = time.monotonic()
+    svc.submit("count", encode_upload(m, reports[0]))
+    fast = time.monotonic() - t0
+    t0 = time.monotonic()
+    svc.submit("count", encode_upload(m, reports[1]))
+    stalled = time.monotonic() - t0
+    assert stalled >= 0.3 > fast
+
+
+def test_kill_during_admission_subprocess():
+    """`kill:party=collector:step=admit` dies with the injector's
+    exit code before any compile — the service crashes attributably
+    at the ingest door, and a fresh boot is clean (uploads since the
+    last snapshot are the client's to retry, as in any ingest
+    service)."""
+    env = {**os.environ,
+           "JAX_PLATFORMS": "cpu",
+           "MASTIC_FAULTS": "kill:party=collector:step=admit:nth=3"}
+    proc = subprocess.run(
+        [sys.executable, "tools/serve.py", "--reports", "4",
+         "--epochs", "1"],
+        cwd=str(REPO), env=env, capture_output=True, text=True,
+        timeout=240)
+    assert proc.returncode == faults.KILL_EXIT_CODE, proc.stderr[-800:]
+
+
+def test_corrupt_page_flush_detected_and_degraded():
+    """`corrupt:party=collector:step=page_flush` mutates a sealed
+    page's stored bytes after its digest — the epoch must detect the
+    mismatch, drop the page with reason `page-corrupt`, and degrade
+    (here: every page corrupt, so the epoch finalizes empty) instead
+    of aggregating garbage."""
+    m = MasticCount(2)
+    inj = faults.FaultInjector(
+        faults.parse_faults(
+            "corrupt:party=collector:step=page_flush:offset=9"),
+        "collector")
+    svc = CollectorService([_spec()], config=_cfg(page_size=2),
+                           injector=inj)
+    _admit(svc, "count", m, _reports(m, [0, 3]))
+    assert svc.begin_epoch("count") == 0
+    assert not svc.step()                   # degraded, drained
+    t = svc.metrics()["tenants"]["count"]
+    assert t["counters"]["pages_corrupt"] == 1
+    assert t["counters"]["quarantine_reasons"] == {"page-corrupt": 2}
+    rec = t["epochs"][0]
+    assert rec["truncated"] and rec["result"] == []
+    assert rec["levels_completed"] == 0
+
+
+# -- supervision: a raising round must not take the service down ----
+
+class _StubRun:
+    """Duck-typed CollectionRun: completes in 2 steps, or raises on
+    every step when flaky (a rebuilt replacement is healthy)."""
+
+    def __init__(self, flaky):
+        self.flaky = flaky
+        self.metrics = []
+        self.done = False
+        self._n = 0
+
+    def step(self):
+        if self.flaky:
+            raise RuntimeError("injected round failure")
+        self._n += 1
+        self.done = self._n >= 2
+        return not self.done
+
+    def result(self):
+        return ["ok"]
+
+    def frontier(self):
+        return []
+
+    def rounds_completed(self):
+        return self._n
+
+    def to_bytes(self):
+        return b"{}"
+
+
+def test_supervised_retry_rebuilds_run():
+    """The first round raising marks a failure and REBUILDS the run
+    from the epoch's reports (a half-executed round may have left
+    device state inconsistent); the rebuilt run completes and the
+    epoch record is clean."""
+    m = MasticCount(2)
+    svc = CollectorService([_spec()], config=_cfg(epoch_retries=1))
+    builds = []
+
+    def fake_build(t, reports):
+        run = _StubRun(flaky=not builds)   # only the first is flaky
+        builds.append(run)
+        return run
+
+    svc._build_run = fake_build
+    _admit(svc, "count", m, _reports(m, [0, 3]))
+    svc.begin_epoch("count")
+    assert svc.run_until_drained(deadline=Deadline(30.0))
+    assert len(builds) == 2
+    rec = svc.metrics()["tenants"]["count"]["epochs"][0]
+    assert "error" not in rec and not rec["truncated"]
+    assert rec["result"] == ["ok"]
+    c = svc.metrics()["tenants"]["count"]["counters"]
+    assert c["epochs_completed"] == 1 and c["epochs_failed"] == 0
+
+
+def test_run_construction_refusal_fails_epoch_not_service():
+    """A tenant whose run cannot even be built (e.g. the memory
+    envelope refuses its chunk config) fails its epoch attributably;
+    the service keeps going."""
+    m = MasticCount(2)
+    svc = CollectorService([_spec()], config=_cfg())
+
+    def refuse(t, reports):
+        raise ValueError("envelope refused")
+
+    svc._build_run = refuse
+    _admit(svc, "count", m, _reports(m, [0, 3]))
+    svc.begin_epoch("count")
+    assert not svc.step()
+    rec = svc.metrics()["tenants"]["count"]["epochs"][0]
+    assert rec["truncated"] and "envelope refused" in rec["error"]
+    c = svc.metrics()["tenants"]["count"]["counters"]
+    assert c["epochs_failed"] == 1
+    # admission still works afterwards
+    assert svc.submit(
+        "count", encode_upload(m, _reports(m, [1])[0]))[0] == ADMITTED
+
+
+def test_supervised_epoch_fails_after_retries_exhausted():
+    m = MasticCount(2)
+    svc = CollectorService([_spec()], config=_cfg(epoch_retries=1))
+    svc._build_run = lambda t, reports: _StubRun(flaky=True)
+    _admit(svc, "count", m, _reports(m, [0, 3]))
+    svc.begin_epoch("count")
+    assert svc.run_until_drained(deadline=Deadline(30.0))
+    rec = svc.metrics()["tenants"]["count"]["epochs"][0]
+    assert rec["truncated"] and "injected round failure" in rec["error"]
+    c = svc.metrics()["tenants"]["count"]["counters"]
+    assert c["epochs_failed"] == 1 and c["epochs_completed"] == 0
+
+
+# -- snapshot plumbing (no rounds) -----------------------------------
+
+def test_snapshot_refuses_garbage():
+    with pytest.raises(ValueError):
+        CollectorService.from_bytes(b"\xff" * 64)
+
+
+def test_snapshot_roundtrip_preserves_buffers_and_counters():
+    m = MasticCount(2)
+    svc = CollectorService([_spec()], config=_cfg(page_size=2))
+    svc.submit("count", b"junk")                      # quarantine
+    _admit(svc, "count", m, _reports(m, [0, 3, 1]))   # page + open
+    assert svc.begin_epoch("count") == 0              # seals the tail
+    _admit(svc, "count", m, _reports(m, [2]))         # new open page
+    blob = svc.to_bytes()
+    svc2 = CollectorService.from_bytes(blob, config=_cfg(page_size=2))
+    (t1, t2) = (svc.metrics()["tenants"]["count"],
+                svc2.metrics()["tenants"]["count"])
+    assert t2["buffered_reports"] == t1["buffered_reports"] == 4
+    assert t2["pending_epochs"] == 1 and t2["open_page"] == 1
+    assert t2["counters"]["quarantined"] == 1
+    assert t2["counters"]["resumes"] == 1
+    # the restored open page keeps accepting uploads
+    assert svc2.submit(
+        "count", encode_upload(m, _reports(m, [1])[0]))[0] == ADMITTED
+
+
+def test_thresholds_json_roundtrip():
+    thr = {"default": 5, (False, True): 2, (True,): 9}
+    assert thresholds_from_json(thresholds_to_json(thr)) == thr
+    enc = json.dumps(thresholds_to_json(thr))   # must be JSON-safe
+    assert thresholds_from_json(json.loads(enc)) == thr
+
+
+def test_collection_run_interface_registration():
+    from mastic_tpu.drivers.attribute_metrics import AttributeMetricsRun
+
+    assert issubclass(HeavyHittersRun, CollectionRun)
+    assert issubclass(AttributeMetricsRun, CollectionRun)
+
+
+def test_heavy_hitters_frontier_semantics():
+    """frontier() is the truncated-but-correct contract: [] before
+    any completed level, the unique parents of the expanded candidate
+    set mid-run, the final hitters when done."""
+    m = MasticCount(3)
+    run = HeavyHittersRun(m, CTX, {"default": 2},
+                          _reports(m, [0, 7], bits=3),
+                          incremental=False)
+    assert run.frontier() == []
+    # mid-run state as step() leaves it after level 0: survivors
+    # (False,), (True,) expanded into their children.
+    run.level = 1
+    run.prefixes = [(False, False), (False, True),
+                    (True, False), (True, True)]
+    assert run.frontier() == [(False,), (True,)]
+    run.done = True
+    run.heavy_hitters = [(False, False, False)]
+    assert run.frontier() == [(False, False, False)]
+
+
+# -- the end-to-end acceptance: scheduler == offline batch, resume ---
+
+@pytest.mark.slow
+def test_epoch_bit_identical_to_offline_with_mid_epoch_resume():
+    """One tenant, two epochs over the same values: (a) the scheduler
+    path's hitters and per-level accept counters equal the offline
+    batch run's bit for bit; (b) an epoch snapshotted mid-run,
+    abandoned (the kill-9 state model: only the snapshot survives),
+    and resumed in a fresh service finishes with the identical
+    result.
+
+    Slow-marked to keep the plain fast tier inside its budget, but
+    `make serve-smoke` runs it explicitly by node id — it IS the
+    gate's acceptance test."""
+    m = MasticCount(2)
+    vk = gen_rand(m.VERIFY_KEY_SIZE)
+    values = [0, 0, 0, 3, 3]
+    reports = _reports(m, values)
+
+    offline = HeavyHittersRun(m, CTX, {"default": 2}, reports,
+                              verify_key=vk)
+    while offline.step():
+        pass
+
+    svc = CollectorService([_spec(vk=vk)], config=_cfg(page_size=3))
+    _admit(svc, "count", m, reports)
+    assert svc.begin_epoch("count") == 0
+    assert svc.run_until_drained(deadline=Deadline(600.0))
+    rec = svc.metrics()["tenants"]["count"]["epochs"][0]
+    assert not rec["truncated"]
+    assert rec["result"] == [[bool(b) for b in p]
+                             for p in offline.result()]
+    assert rec["levels_completed"] == len(offline.metrics)
+
+    # (b) second epoch: same uploads, snapshot after one round,
+    # abandon the live service, resume, finish — bit-identical.
+    _admit(svc, "count", m, reports)
+    assert svc.begin_epoch("count") == 1
+    assert svc.step()            # one scheduler quantum = one round
+    active = next(iter(svc.tenants.values())).active
+    assert active is not None and len(active.run.metrics) == 1
+    mx0 = active.run.metrics[0]
+    assert mx0.extra["service"]["tenant"] == "count"
+    assert mx0.extra["service"]["epoch"] == 1
+    assert mx0.accepted == offline.metrics[0].accepted
+    blob = svc.to_bytes()
+    del svc                      # kill -9 state model
+    svc2 = CollectorService.from_bytes(blob, config=_cfg(page_size=3))
+    assert svc2.run_until_drained(deadline=Deadline(600.0))
+    rec2 = svc2.metrics()["tenants"]["count"]["epochs"][1]
+    assert not rec2["truncated"]
+    assert rec2["result"] == rec["result"]
+    assert rec2["levels_completed"] == rec["levels_completed"]
+    c = svc2.metrics()["tenants"]["count"]["counters"]
+    assert c["resumes"] == 1 and c["epochs_completed"] == 2
+
+
+# -- slow tier: subprocess kill-9, interleaving, deadline, mesh ------
+
+def _run_serve(extra_args, fault_spec=None, timeout=900):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("MASTIC_FAULTS", None)
+    if fault_spec is not None:
+        env["MASTIC_FAULTS"] = fault_spec
+    return subprocess.run(
+        [sys.executable, "tools/serve.py"] + extra_args,
+        cwd=str(REPO), env=env, capture_output=True, text=True,
+        timeout=timeout)
+
+
+@pytest.mark.slow
+def test_serve_kill9_mid_epoch_resume_bit_identical(tmp_path):
+    """The full acceptance drill through tools/serve.py: a clean run,
+    a run killed (hard process exit) mid-epoch by the injector at the
+    scheduler's epoch_round checkpoint, and a --resume run from the
+    killed run's snapshot — results bit-identical to the clean run."""
+    snap_a = str(tmp_path / "clean.snap")
+    clean = _run_serve(["--reports", "5", "--snapshot", snap_a])
+    assert clean.returncode == 0, clean.stderr[-2000:]
+    clean_out = json.loads(clean.stdout.strip().splitlines()[-1])
+
+    snap_b = str(tmp_path / "killed.snap")
+    killed = _run_serve(
+        ["--reports", "5", "--snapshot", snap_b],
+        fault_spec="kill:party=collector:step=epoch_round:nth=2")
+    assert killed.returncode == faults.KILL_EXIT_CODE, \
+        killed.stderr[-2000:]
+    assert os.path.exists(snap_b)
+
+    resumed = _run_serve(["--reports", "5", "--snapshot", snap_b,
+                          "--resume"])
+    assert resumed.returncode == 0, resumed.stderr[-2000:]
+    resumed_out = json.loads(resumed.stdout.strip().splitlines()[-1])
+    assert resumed_out["results"] == clean_out["results"]
+
+
+@pytest.mark.slow
+def test_two_tenants_interleave_and_match_offline():
+    """Two tenants (multi-round heavy hitters + single-round
+    attribute metrics) multiplex through one scheduler round-robin;
+    each tenant's output equals its offline driver's."""
+    from mastic_tpu.drivers.attribute_metrics import (
+        aggregate_by_attribute, hash_attribute)
+
+    m = MasticCount(2)
+    m_attr = MasticCount(8)
+    (vk, vk_attr) = (gen_rand(m.VERIFY_KEY_SIZE),
+                     gen_rand(m_attr.VERIFY_KEY_SIZE))
+    hh_reports = _reports(m, [0, 0, 3, 3, 1])
+    alpha = hash_attribute(m_attr, "checkout.html")
+    attr_val = int("".join("1" if b else "0" for b in alpha), 2)
+    attr_meas = [(m_attr.vidpf.test_index_from_int(v, 8), True)
+                 for v in (attr_val, attr_val, 0)]
+    attr_reports = get_reports_from_measurements(m_attr, CTX,
+                                                 attr_meas)
+    attrs = ["checkout.html", "landing.html"]
+
+    offline_hh = HeavyHittersRun(m, CTX, {"default": 2}, hh_reports,
+                                 verify_key=vk)
+    while offline_hh.step():
+        pass
+    offline_attr = aggregate_by_attribute(m_attr, CTX, attrs,
+                                          attr_reports,
+                                          verify_key=vk_attr)
+
+    svc = CollectorService(
+        [_spec(vk=vk),
+         TenantSpec(name="attrs",
+                    spec={"class": "MasticCount", "args": [8]},
+                    ctx=CTX, verify_key=vk_attr,
+                    mode="attribute_metrics", attributes=attrs)],
+        config=_cfg())
+    _admit(svc, "count", m, hh_reports)
+    _admit(svc, "attrs", m_attr, attr_reports)
+    svc.begin_epoch("count")
+    svc.begin_epoch("attrs")
+    # Per-quantum tenant sequence, recovered from the rounds-counter
+    # deltas: round-robin must interleave the attrs round between the
+    # count epoch's levels, not serialize whole epochs.
+    seq = []
+    prev = {"count": 0, "attrs": 0}
+    while True:
+        more = svc.step()
+        for (name, t) in svc.tenants.items():
+            rounds = t.counters.rounds
+            if rounds != prev[name]:
+                seq.append(name)
+                prev[name] = rounds
+        if not more:
+            break
+    assert svc.drained()
+    assert seq == ["count", "attrs", "count"]
+    mx = svc.metrics()["tenants"]
+    assert mx["count"]["epochs"][0]["result"] == \
+        [[bool(b) for b in p] for p in offline_hh.result()]
+    assert mx["attrs"]["epochs"][0]["result"] == \
+        [[a, v] for (a, v) in offline_attr]
+    # both tenants were scheduled (round-robin interleave): the
+    # attrs round ran before the count epoch finished
+    assert mx["attrs"]["counters"]["rounds"] == 1
+    assert mx["count"]["counters"]["rounds"] == \
+        mx["count"]["epochs"][0]["levels_completed"]
+
+
+@pytest.mark.slow
+def test_epoch_deadline_truncates_to_completed_frontier():
+    """An epoch that blows its deadline finishes at the last
+    completed level: the record is marked truncated and carries the
+    survivors of the rounds that DID run (here level 0's), nothing
+    deeper."""
+    m = MasticCount(2)
+    svc = CollectorService(
+        # budget covers the (compile-heavy) level-0 round but expires
+        # well before level 1's check — the cold compile on this
+        # fabric takes tens of seconds, the margin is wide
+        [_spec(epoch_deadline=3.0)],
+        config=_cfg())
+    _admit(svc, "count", m, _reports(m, [0, 0, 3, 3, 1]))
+    svc.begin_epoch("count")
+    assert svc.step()            # level 0 runs (slow: compile)
+    assert not svc.step()        # deadline gone: truncate, drain
+    t = svc.metrics()["tenants"]["count"]
+    rec = t["epochs"][0]
+    assert rec["truncated"] and rec["levels_completed"] == 1
+    # both 1-bit prefixes pass threshold 2 (counts 3 and 2)
+    assert sorted(rec["result"]) == [[False], [True]]
+    assert t["counters"]["deadline_misses"] == 1
+    assert t["counters"]["epochs_truncated"] == 1
+
+
+@pytest.mark.slow
+def test_service_mesh_bit_identical(tmp_path):
+    """The scheduler path under report-axis mesh sharding produces
+    the same epoch record as the single-device service (the r10
+    bit-identity contract composed with the service layer)."""
+    import jax
+
+    from mastic_tpu.parallel import make_mesh
+
+    if jax.device_count() < 2:
+        pytest.skip("needs >= 2 (virtual) devices")
+    m = MasticCount(2)
+    vk = gen_rand(m.VERIFY_KEY_SIZE)
+    reports = _reports(m, [0, 0, 3, 3, 1])
+
+    def run_service(mesh):
+        svc = CollectorService(
+            [_spec(vk=vk, chunk_size=3)],
+            config=_cfg(page_size=3), mesh=mesh)
+        _admit(svc, "count", m, reports)
+        svc.begin_epoch("count")
+        assert svc.run_until_drained(deadline=Deadline(900.0))
+        rec = svc.metrics()["tenants"]["count"]["epochs"][0]
+        rec.pop("wall_s", None)
+        return rec
+
+    plain = run_service(None)
+    meshed = run_service(make_mesh(2, nodes_axis=1))
+    assert meshed == plain

@@ -9,7 +9,8 @@ contract it checks:
   dtypes      DT001-DT003   mastic_tpu/ops/ (field/AES/Keccak kernels)
   secretflow  SF001-SF002   vidpf.py, mastic.py, aes.py, xof.py
   pallasck    PL001-PL004   any file calling pallas_call
-  robustness  RB001-RB002   mastic_tpu/drivers/ (session layer)
+  robustness  RB001-RB005   mastic_tpu/drivers/ + tools/serve.py
+                            (session layer + collector service)
 
 plus the suppression meta-rules AL001 (mastic-allow without a written
 justification) and AL002 (mastic-allow that silences nothing), and

@@ -31,6 +31,25 @@ pass keeps out of the tree:
          right, only the placement and the interconnect traffic go
          wrong).  Genuinely single-device puts carry an allow.
 
+  RB004  unbounded buffer growth in the long-lived layer (ISSUE 6):
+         a `queue.Queue()` / `collections.deque()` constructed with
+         no capacity bound, or an `.append(...)` inside a
+         constant-true `while` loop with neither a `break` nor a
+         `len(...)` bound check in the loop — a continuously
+         ingesting collector that buffers without a quota or shed
+         policy converts overload into an OOM kill instead of a
+         counted, policied shed (drivers/service.py's admission
+         contract).
+
+  RB005  a deadline-less `while` loop inside a service/scheduler
+         class (a ClassDef whose name contains "Service" or
+         "Scheduler"): the loop's test+body reference nothing
+         deadline-shaped (an identifier containing "deadline", or a
+         call to `.expired()` / `.remaining()`), so a wedged epoch
+         or a never-draining queue spins the loop forever with no
+         bounded exit.  Loops bounded by construction carry an
+         allow naming the bound.
+
 Intentional exceptions are suppressed inline with a justified
 `# mastic-allow: RB00x — reason`, same as every other pass.
 """
@@ -47,16 +66,23 @@ RULES = {
              "structured report",
     "RB003": "direct device_put in drivers/ bypasses "
              "place_reports' mesh placement",
+    "RB004": "unbounded queue/list growth without a capacity bound "
+             "or shed policy",
+    "RB005": "deadline-less while loop in service scheduler code",
 }
 
 SCOPE_PREFIX = "mastic_tpu/drivers/"
+
+# The service CLI lives in tools/ but owns the same long-lived-loop
+# failure modes the drivers do.
+EXTRA_FILES = ("tools/serve.py",)
 
 _BLOCKING_READS = {"accept", "recv", "recv_into", "makefile"}
 _CONNECT_FNS = {"create_connection"}
 
 
 def in_scope(rel: str) -> bool:
-    return rel.startswith(SCOPE_PREFIX)
+    return rel.startswith(SCOPE_PREFIX) or rel in EXTRA_FILES
 
 
 def _scopes(tree: ast.Module):
@@ -184,11 +210,112 @@ def _check_rb003(info, findings) -> None:
             "put"))
 
 
+_QUEUE_CTORS = {"Queue", "LifoQueue", "PriorityQueue", "SimpleQueue",
+                "deque"}
+_QUEUE_BOUND_KWS = {"maxsize", "maxlen"}
+
+
+def _const_true(test: ast.AST) -> bool:
+    return isinstance(test, ast.Constant) and bool(test.value)
+
+
+def _check_rb004(info, findings) -> None:
+    """Unbounded growth: capacity-less queue constructions, and
+    appends inside a constant-true loop with no break and no len()
+    bound check anywhere in the loop."""
+    for node in ast.walk(info.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = (f.attr if isinstance(f, ast.Attribute)
+                else f.id if isinstance(f, ast.Name) else None)
+        if name not in _QUEUE_CTORS:
+            continue
+        bounded = any(
+            kw.arg in _QUEUE_BOUND_KWS
+            and not (isinstance(kw.value, ast.Constant)
+                     and kw.value.value in (None, 0))
+            for kw in node.keywords)
+        if name == "deque":
+            bounded = bounded or len(node.args) >= 2
+        else:
+            bounded = bounded or (
+                node.args
+                and not (isinstance(node.args[0], ast.Constant)
+                         and node.args[0].value in (None, 0)))
+        if not bounded:
+            findings.append(Finding(
+                "RB004", info.rel, node.lineno,
+                f"{name}() without a capacity bound grows without "
+                f"limit under sustained ingest — pass maxsize/maxlen "
+                f"(and shed on full), or allow with the reason the "
+                f"producer is bounded"))
+    for node in ast.walk(info.tree):
+        if not isinstance(node, ast.While) \
+                or not _const_true(node.test):
+            continue
+        (appends, has_break, has_bound) = ([], False, False)
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Break):
+                has_break = True
+            elif isinstance(sub, ast.Call):
+                f = sub.func
+                if isinstance(f, ast.Attribute) \
+                        and f.attr == "append":
+                    appends.append(sub)
+                elif isinstance(f, ast.Name) and f.id == "len":
+                    has_bound = True
+        if appends and not has_break and not has_bound:
+            findings.append(Finding(
+                "RB004", info.rel, appends[0].lineno,
+                "append inside a `while True` loop with no break and "
+                "no len() bound check — unbounded buffer growth; "
+                "bound the buffer (shed policy) or exit the loop"))
+
+
+_DEADLINE_CALLS = {"expired", "remaining"}
+
+
+def _references_deadline(loop: ast.While) -> bool:
+    for sub in ast.walk(loop):
+        if isinstance(sub, ast.Name) \
+                and "deadline" in sub.id.lower():
+            return True
+        if isinstance(sub, ast.Attribute) \
+                and ("deadline" in sub.attr.lower()
+                     or sub.attr in _DEADLINE_CALLS):
+            return True
+    return False
+
+
+def _check_rb005(info, findings) -> None:
+    """Deadline-less while loops inside Service/Scheduler classes —
+    the long-lived scheduler layer where every loop needs a bounded
+    exit (drivers/service.py's contract)."""
+    for node in ast.walk(info.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        if "Service" not in node.name and "Scheduler" not in node.name:
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.While) \
+                    and not _references_deadline(sub):
+                findings.append(Finding(
+                    "RB005", info.rel, sub.lineno,
+                    f"while loop in {node.name} references no "
+                    f"deadline (no *deadline* identifier, no "
+                    f".expired()/.remaining() call) — a wedged epoch "
+                    f"spins it forever; thread a Deadline through, "
+                    f"or allow naming the structural bound"))
+
+
 def check(info) -> list:
     findings: list = []
     _check_rb001(info, findings)
     _check_rb002(info, findings)
     _check_rb003(info, findings)
+    _check_rb004(info, findings)
+    _check_rb005(info, findings)
     seen = set()
     out = []
     for f in findings:

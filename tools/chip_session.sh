@@ -77,6 +77,14 @@ run pipeline-off python bench.py --chunked-round-only --pipeline off
 run mesh-1 python bench.py --chunked-round-only --mesh 1
 run mesh-all python bench.py --chunked-round-only --mesh all
 
+# 6. Unattended collector-service soak (drivers/service.py +
+# tools/serve.py): continuous admit -> epoch -> drain on the chip
+# for two minutes, every epoch's hitters checked — a service that
+# wedges, leaks, or degrades mid-soak fails this cell, and the JSON
+# line records epochs/rounds completed plus the full counter ledger
+# (scheduler-overhead numbers for PERF.md).
+run serve-soak python tools/serve.py --soak 120 --bits 4 --reports 32
+
 # Every on-chip run persists itself to BENCH_LAST_GOOD; end on the
 # default configuration so the cached record reflects the default
 # levers, not whichever matrix cell happened to run last.

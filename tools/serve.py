@@ -1,0 +1,483 @@
+"""Collector-service driver: boot a long-lived multi-tenant service
+(`mastic_tpu/drivers/service.py`), stream synthetic uploads through
+it, and drain epochs — the serving twin of the offline
+`tools/northstar.py` batch run.
+
+Three modes:
+
+* default — build the demo tenants (a heavy-hitters Count collection
+  and an attribute-metrics collection at a different bit-width),
+  admit `--reports` seeded uploads per tenant per epoch, run
+  `--epochs` epochs each through the scheduler, and print one JSON
+  line with the per-tenant results and the full service metrics.
+  With `--snapshot PATH` the service state is written (atomic
+  rename) after admission and after every scheduler round, so a
+  `kill -9` at any point loses at most the round in flight;
+  `--resume` restores from the snapshot instead of re-admitting —
+  the kill-and-resume test drives exactly this pair.
+
+* ``--smoke`` — the `make serve-smoke` gate: two tenants plus
+  overload/deadline scratch tenants, a malformed-upload burst
+  (quarantined, tenant-attributed), sustained overload against a
+  tiny quota (bounded memory, sheds counted under both policies), an
+  epoch-deadline miss (degrades to the truncated frontier, marked),
+  and a mid-epoch crash drill (snapshot, discard the live service,
+  resume, bit-identical result).  Any violated expectation exits
+  non-zero with the reason; the JSON line carries ``"ok": true``
+  otherwise.
+
+* ``--soak SECONDS`` — the unattended chip-session cell: loop
+  admit -> epoch -> drain under one deadline, reporting epochs
+  completed, rounds, and counter totals (a service that leaks,
+  wedges, or sheds silently fails loudly here).
+
+`MASTIC_FAULTS` (party ``collector``) is honored end to end — the
+service arms its injector from the environment, so e.g.
+``kill:party=collector:step=epoch_round:nth=2`` exercises a real
+process death mid-epoch against the snapshot/resume pair.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def build_reports(m, ctx, rng, values, bits):
+    """Seeded client uploads: shard each value with rng-derived
+    nonce/rand so two processes with one --seed build byte-identical
+    reports (the unfaulted / faulted+resumed comparison needs it)."""
+    reports = []
+    for v in values:
+        alpha = m.vidpf.test_index_from_int(v, bits)
+        nonce = bytes(rng.integers(0, 256, m.NONCE_SIZE,
+                                   dtype="uint8"))
+        rand = bytes(rng.integers(0, 256, m.RAND_SIZE, dtype="uint8"))
+        (ps, shares) = m.shard(ctx, (alpha, True), nonce, rand)
+        reports.append((nonce, ps, shares))
+    return reports
+
+
+def strip_wall(records):
+    """Epoch records minus wall-clock stamps (the bit-identity
+    comparison target: everything except timing)."""
+    out = []
+    for rec in records:
+        rec = dict(rec)
+        rec.pop("wall_s", None)
+        out.append(rec)
+    return out
+
+
+def admit_all(svc, tenant, m, reports, expect=None):
+    from mastic_tpu.drivers.service import encode_upload
+
+    outcomes = []
+    for r in reports:
+        outcomes.append(svc.submit(tenant, encode_upload(m, r)))
+    if expect is not None:
+        bad = [o for o in outcomes if o[0] != expect]
+        if bad:
+            fail(f"admission to {tenant}: expected {expect}, "
+                 f"got {bad[:3]}")
+    return outcomes
+
+
+def fail(msg: str) -> None:
+    print(f"serve: FAIL: {msg}", file=sys.stderr, flush=True)
+    sys.exit(1)
+
+
+def drain(svc, snapshot_path=None, deadline=None) -> None:
+    from mastic_tpu.drivers.session import Deadline
+
+    if deadline is None:
+        # The drain itself is deadline-bounded (the scheduler's
+        # per-epoch deadlines bound each epoch; this bounds the loop).
+        deadline = Deadline(3600.0)
+    while svc.step():
+        if snapshot_path:
+            write_snapshot(svc, snapshot_path)
+        if deadline.expired():
+            fail("drain deadline expired with epochs still queued")
+
+
+def write_snapshot(svc, path: str) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(svc.to_bytes())
+    os.replace(tmp, path)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(
+        description="long-lived collector service driver "
+                    "(USAGE.md 'Collector service')")
+    parser.add_argument("--bits", type=int, default=2,
+                        help="tree depth of the heavy-hitters tenant")
+    parser.add_argument("--reports", type=int, default=6,
+                        help="uploads per tenant per epoch")
+    parser.add_argument("--epochs", type=int, default=1)
+    parser.add_argument("--page-size", type=int, default=4)
+    parser.add_argument("--chunk-size", type=int, default=None)
+    parser.add_argument("--mesh", type=int, default=0)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--snapshot", type=str, default=None)
+    parser.add_argument("--resume", action="store_true")
+    parser.add_argument("--smoke", action="store_true",
+                        help="the serve-smoke robustness gate")
+    parser.add_argument("--soak", type=float, default=0.0,
+                        help="unattended soak for SECONDS "
+                             "(chip-session cell)")
+    parser.add_argument("--out", type=str, default=None)
+    args = parser.parse_args()
+
+    if args.resume and not args.snapshot:
+        parser.error("--resume needs --snapshot PATH")
+    if args.mesh:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{args.mesh}").strip()
+
+    import numpy as np
+    import jax
+
+    requested = os.environ.get("JAX_PLATFORMS", "").strip()
+    if requested and "axon" not in requested.split(","):
+        jax.config.update("jax_platforms", requested)
+
+    mesh = None
+    if args.mesh:
+        from mastic_tpu.parallel import make_mesh
+        mesh = make_mesh(args.mesh, nodes_axis=1)
+
+    if args.smoke:
+        run_smoke(args, mesh)
+        return
+
+    from mastic_tpu.drivers.service import (CollectorService,
+                                            ServiceConfig, TenantSpec)
+    from mastic_tpu.mastic import MasticCount
+
+    t_start = time.time()
+    bits = args.bits
+    m_count = MasticCount(bits)
+    m_attr = MasticCount(8)
+    rng = np.random.default_rng(args.seed)
+    # Deterministic keys: the resumed process must rebuild the same
+    # tenant bindings the snapshot header carries.
+    vk_count = bytes(rng.integers(0, 256, m_count.VERIFY_KEY_SIZE,
+                                  dtype="uint8"))
+    vk_attr = bytes(rng.integers(0, 256, m_attr.VERIFY_KEY_SIZE,
+                                 dtype="uint8"))
+    threshold = max(2, int(args.reports * 0.4))
+    tenants = [
+        TenantSpec(name="count",
+                   spec={"class": "MasticCount", "args": [bits]},
+                   ctx=b"serve count", verify_key=vk_count,
+                   thresholds={"default": threshold},
+                   chunk_size=args.chunk_size),
+        TenantSpec(name="attrs",
+                   spec={"class": "MasticCount", "args": [8]},
+                   ctx=b"serve attrs", verify_key=vk_attr,
+                   mode="attribute_metrics",
+                   attributes=["checkout.html", "landing.html"],
+                   chunk_size=args.chunk_size),
+    ]
+    config = ServiceConfig.from_env()
+    config.page_size = args.page_size
+
+    if args.resume:
+        with open(args.snapshot, "rb") as f:
+            svc = CollectorService.from_bytes(f.read(), config=config,
+                                              mesh=mesh)
+    else:
+        svc = CollectorService(tenants, config=config, mesh=mesh)
+
+    hot = args.reports // 2
+    count_values = [0] * hot + [2 ** bits - 1] * (args.reports - hot)
+    from mastic_tpu.drivers.attribute_metrics import hash_attribute
+    attr_alpha = hash_attribute(m_attr, "checkout.html")
+    attr_int = int("".join("1" if b else "0" for b in attr_alpha), 2)
+    attr_values = [attr_int] * max(1, args.reports - 2) \
+        + [0] * min(2, args.reports)
+
+    if args.soak:
+        run_soak(args, svc, m_count, count_values, rng, t_start)
+        return
+
+    if not args.resume:
+        for _ in range(args.epochs):
+            reports = build_reports(m_count, b"serve count", rng,
+                                    count_values, bits)
+            admit_all(svc, "count", m_count, reports)
+            svc.begin_epoch("count")
+            reports = build_reports(m_attr, b"serve attrs", rng,
+                                    attr_values, 8)
+            admit_all(svc, "attrs", m_attr, reports)
+            svc.begin_epoch("attrs")
+        if args.snapshot:
+            write_snapshot(svc, args.snapshot)
+    drain(svc, snapshot_path=args.snapshot)
+    if args.snapshot:
+        write_snapshot(svc, args.snapshot)
+
+    metrics = svc.metrics()
+    out = {
+        "mode": "resume" if args.resume else "serve",
+        "platform": jax.devices()[0].platform,
+        "bits": bits, "reports": args.reports,
+        "epochs": args.epochs,
+        "mesh_devices": args.mesh or 1,
+        "wall_seconds": round(time.time() - t_start, 1),
+        "results": {name: strip_wall(t["epochs"])
+                    for (name, t) in metrics["tenants"].items()},
+        "metrics": metrics,
+        "ok": True,
+    }
+    line = json.dumps(out)
+    print(line, flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+
+
+def run_soak(args, svc, m_count, count_values, rng, t_start) -> None:
+    """Unattended soak: admit -> epoch -> drain in a loop under one
+    deadline; every epoch's output is checked against the expected
+    hitters, so a service that degrades mid-soak fails the cell."""
+    import jax
+
+    from mastic_tpu.drivers.service import encode_upload
+    from mastic_tpu.drivers.session import Deadline
+
+    bits = args.bits
+    expected = sorted([[False] * bits, [True] * bits])
+    deadline = Deadline(args.soak)
+    epochs = 0
+    while not deadline.expired():
+        reports = build_reports(m_count, b"serve count", rng,
+                                count_values, bits)
+        for r in reports:
+            svc.submit("count", encode_upload(m_count, r))
+        svc.begin_epoch("count")
+        drain(svc, snapshot_path=args.snapshot, deadline=deadline)
+        recs = svc.metrics()["tenants"]["count"]["epochs"]
+        if recs and not recs[-1]["truncated"]:
+            epochs += 1
+            got = sorted(recs[-1]["result"])
+            if got != expected:
+                fail(f"soak epoch {epochs}: hitters {got} != "
+                     f"{expected}")
+    counters = svc.metrics()["tenants"]["count"]["counters"]
+    out = {
+        "mode": "soak",
+        "platform": jax.devices()[0].platform,
+        "soak_seconds": args.soak,
+        "epochs_completed": epochs,
+        "rounds": counters["rounds"],
+        "wall_seconds": round(time.time() - t_start, 1),
+        "counters": counters,
+        "ok": epochs >= 1,
+    }
+    print(json.dumps(out), flush=True)
+    if not out["ok"]:
+        sys.exit(1)
+
+
+def run_smoke(args, mesh) -> None:
+    """The serve-smoke gate: one process, every defensive behavior
+    demonstrated and asserted (module docstring lists them)."""
+    import numpy as np
+    import jax
+
+    from mastic_tpu.drivers.service import (ADMITTED, QUARANTINED,
+                                            SHED, CollectorService,
+                                            ServiceConfig, TenantSpec,
+                                            encode_upload)
+    from mastic_tpu.mastic import MasticCount
+
+    t_start = time.time()
+    rng = np.random.default_rng(args.seed)
+    bits = 2
+    m = MasticCount(bits)
+    m_attr = MasticCount(8)
+    vk = bytes(rng.integers(0, 256, m.VERIFY_KEY_SIZE, dtype="uint8"))
+    vk_attr = bytes(rng.integers(0, 256, m_attr.VERIFY_KEY_SIZE,
+                                 dtype="uint8"))
+
+    def specs():
+        return [
+            TenantSpec(name="count",
+                       spec={"class": "MasticCount", "args": [bits]},
+                       ctx=b"smoke count", verify_key=vk,
+                       thresholds={"default": 2},
+                       chunk_size=args.chunk_size),
+            TenantSpec(name="attrs",
+                       spec={"class": "MasticCount", "args": [8]},
+                       ctx=b"smoke attrs", verify_key=vk_attr,
+                       mode="attribute_metrics",
+                       attributes=["checkout.html", "landing.html"],
+                       chunk_size=args.chunk_size),
+            # Overload scratch tenant: tiny quota, never scheduled.
+            TenantSpec(name="flood",
+                       spec={"class": "MasticCount", "args": [bits]},
+                       ctx=b"smoke flood", verify_key=vk,
+                       thresholds={"default": 2}, max_buffered=5),
+            # Deadline tenant: an already-expired epoch budget, so
+            # its epoch degrades to the truncated frontier.
+            TenantSpec(name="slow",
+                       spec={"class": "MasticCount", "args": [bits]},
+                       ctx=b"smoke slow", verify_key=vk,
+                       thresholds={"default": 2}, epoch_deadline=0.0),
+        ]
+
+    config = ServiceConfig(page_size=3, max_buffered=64,
+                           max_pending_epochs=2,
+                           shed_policy="reject-newest",
+                           quarantine_limit=16,
+                           epoch_deadline=600.0)
+    svc = CollectorService(specs(), config=config, mesh=mesh)
+
+    # 1. malformed-upload burst: reason-coded quarantine, tenant-
+    # attributed; the other tenants are untouched.
+    for blob in (b"", b"\x07garbage", b"\xff" * 40):
+        (status, detail) = svc.submit("count", blob)
+        if status != QUARANTINED:
+            fail(f"malformed blob admitted: {(status, detail)}")
+    qm = svc.metrics()["tenants"]
+    if qm["count"]["counters"]["quarantined"] != 3 \
+            or qm["count"]["suspended"] \
+            or qm["attrs"]["counters"]["quarantined"] != 0:
+        fail(f"quarantine counters wrong: {qm['count']['counters']}")
+
+    # 2. sustained overload against the flood tenant's quota of 5:
+    # admission stays bounded, sheds are counted, memory is pages
+    # not uploads.
+    flood_reports = build_reports(m, b"smoke flood", rng,
+                                  [0] * 12, bits)
+    outcomes = admit_all(svc, "flood", m, flood_reports)
+    admitted = sum(1 for o in outcomes if o[0] == ADMITTED)
+    shed = sum(1 for o in outcomes if o[0] == SHED)
+    fm = svc.metrics()["tenants"]["flood"]
+    if admitted != 5 or shed != 7 \
+            or fm["buffered_reports"] != 5 \
+            or fm["counters"]["shed_reasons"].get("reject-newest") != 7:
+        fail(f"reject-newest overload wrong: admitted={admitted} "
+             f"shed={shed} {fm['counters']}")
+
+    # 2b. oldest-epoch-first on a scratch service: the oldest queued
+    # epoch is dropped to admit fresh load.  (Fresh spec: the flood
+    # tenant above carries its own tighter max_buffered override.)
+    svc_old = CollectorService(
+        [TenantSpec(name="flood",
+                    spec={"class": "MasticCount", "args": [bits]},
+                    ctx=b"smoke flood", verify_key=vk,
+                    thresholds={"default": 2}, max_buffered=6)],
+        config=ServiceConfig(page_size=3,
+                             max_pending_epochs=2,
+                             shed_policy="oldest-epoch-first",
+                             epoch_deadline=600.0))
+    admit_all(svc_old, "flood", m,
+              build_reports(m, b"smoke flood", rng, [0] * 6, bits),
+              expect=ADMITTED)
+    first_epoch = svc_old.begin_epoch("flood")
+    outcomes = admit_all(svc_old, "flood", m,
+                         build_reports(m, b"smoke flood", rng,
+                                       [1] * 3, bits),
+                         expect=ADMITTED)   # room made by the drop
+    om = svc_old.metrics()["tenants"]["flood"]
+    if first_epoch != 0 or om["pending_epochs"] != 0 \
+            or om["counters"]["shed_reasons"] \
+            .get("oldest-epoch-first") != 6:
+        fail(f"oldest-epoch-first wrong: {om}")
+
+    # 3. real multi-tenant work, admission continuing mid-flight.
+    count_values = [0, 0, 0, 3, 3]
+    count_reports = build_reports(m, b"smoke count", rng,
+                                  count_values, bits)
+    admit_all(svc, "count", m, count_reports, expect=ADMITTED)
+    svc.begin_epoch("count")
+    from mastic_tpu.drivers.attribute_metrics import hash_attribute
+    alpha = hash_attribute(m_attr, "checkout.html")
+    attr_int = int("".join("1" if b else "0" for b in alpha), 2)
+    attr_reports = build_reports(m_attr, b"smoke attrs", rng,
+                                 [attr_int, attr_int, 0], 8)
+    admit_all(svc, "attrs", m_attr, attr_reports, expect=ADMITTED)
+    svc.begin_epoch("attrs")
+    # deadline tenant: its expired budget must degrade, not hang.
+    admit_all(svc, "slow", m,
+              build_reports(m, b"smoke slow", rng, [0, 0, 3], bits),
+              expect=ADMITTED)
+    svc.begin_epoch("slow")
+
+    steps = 0
+    while svc.step():
+        steps += 1
+        if steps == 1:
+            # admission while rounds are in flight: lands in the
+            # open page, joins the NEXT epoch.
+            admit_all(svc, "count", m,
+                      build_reports(m, b"smoke count", rng,
+                                    count_values, bits),
+                      expect=ADMITTED)
+        if steps > 200:
+            fail("drain did not converge")
+
+    mx = svc.metrics()["tenants"]
+    count_rec = mx["count"]["epochs"][0]
+    expected_hitters = sorted([[False] * bits, [True] * bits])
+    if count_rec["truncated"] \
+            or sorted(count_rec["result"]) != expected_hitters:
+        fail(f"count epoch wrong: {count_rec}")
+    attr_rec = mx["attrs"]["epochs"][0]
+    if attr_rec["truncated"] or attr_rec["result"][0][1] != [2] \
+            and attr_rec["result"][0][1] != 2:
+        fail(f"attrs epoch wrong: {attr_rec}")
+    slow_rec = mx["slow"]["epochs"][0]
+    if not slow_rec["truncated"] \
+            or mx["slow"]["counters"]["deadline_misses"] != 1:
+        fail(f"deadline miss not degraded: {slow_rec}")
+
+    # 4. crash drill: second count epoch, snapshot mid-epoch, discard
+    # the live service, resume, drain — result bit-identical to the
+    # first epoch's (same reports are NOT required; same VALUES are,
+    # so compare against epoch 0's result).
+    svc.begin_epoch("count")   # the mid-flight admissions from step 1
+    svc.step()                 # one round into the epoch
+    blob = svc.to_bytes()
+    del svc
+    svc2 = CollectorService.from_bytes(blob, config=config, mesh=mesh)
+    drain(svc2)
+    mx2 = svc2.metrics()["tenants"]
+    resumed_rec = mx2["count"]["epochs"][1]
+    if resumed_rec["truncated"] \
+            or sorted(resumed_rec["result"]) != expected_hitters:
+        fail(f"resumed epoch wrong: {resumed_rec}")
+    if not mx2["count"]["counters"]["resumes"]:
+        fail("resume not counted")
+
+    out = {
+        "mode": "smoke",
+        "platform": jax.devices()[0].platform,
+        "wall_seconds": round(time.time() - t_start, 1),
+        "tenants": {name: t["counters"]
+                    for (name, t) in mx2.items()},
+        "scheduler_rounds": steps,
+        "ok": True,
+    }
+    line = json.dumps(out)
+    print(line, flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()
