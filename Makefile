@@ -21,6 +21,12 @@
 #                tools/serve.py --smoke scenario (two tenants,
 #                malformed burst, overload under both shed policies,
 #                deadline miss, crash drill)
+#   make obs-smoke  telemetry-layer gate (mastic_tpu/obs/, ISSUE 7):
+#                tests/test_obs.py (spans, registry, schema, HTTP
+#                status surface, tracing-on/off bit-identity) plus a
+#                serve.py --smoke --status-port run that self-curls
+#                /metrics, /statusz and /varz and asserts the
+#                expected per-tenant series
 #   make pipeline  pipelined chunk-streaming executor suite
 #                (drivers/pipeline.py: serial bit-identity, overlap
 #                timeline, AOT bucket compile, budget fallback) —
@@ -37,12 +43,12 @@
 
 PY ?= python
 
-.PHONY: ci lint analyze faults serve-smoke pipeline multichip \
-	typecheck test-fast test test-slow test-slow-1 test-slow-2 \
-	test-slow-3 bench
+.PHONY: ci lint analyze faults serve-smoke obs-smoke pipeline \
+	multichip typecheck test-fast test test-slow test-slow-1 \
+	test-slow-2 test-slow-3 bench
 
-ci: lint analyze faults serve-smoke pipeline multichip typecheck \
-	test-fast
+ci: lint analyze faults serve-smoke obs-smoke pipeline multichip \
+	typecheck test-fast
 
 faults:
 	$(PY) -m pytest tests/test_faults.py -q -m "not slow"
@@ -55,6 +61,13 @@ serve-smoke:
 	$(PY) -m pytest tests/test_service.py -q -m "not slow"
 	$(PY) -m pytest -q "tests/test_service.py::test_epoch_bit_identical_to_offline_with_mid_epoch_resume"
 	JAX_PLATFORMS=cpu $(PY) tools/serve.py --smoke
+
+# The status-port smoke reuses serve.py --smoke's scenario with the
+# HTTP surface armed: the run itself curls /metrics, /statusz and
+# /varz and asserts the acceptance series (check_status_endpoints).
+obs-smoke:
+	$(PY) -m pytest tests/test_obs.py -q -m "not slow"
+	JAX_PLATFORMS=cpu $(PY) tools/serve.py --smoke --status-port 0
 
 pipeline:
 	$(PY) -m pytest tests/test_pipeline.py -q -m "not slow"
@@ -78,7 +91,7 @@ typecheck:
 		     "scalar layer) - skipping"; \
 	fi
 
-# test_faults' / test_service's / test_pipeline's /
+# test_faults' / test_service's / test_obs' / test_pipeline's /
 # test_mesh_pipeline's fast tiers already ran as their own gates
 # right after analyze — skip them here so `make ci` doesn't pay for
 # them twice.
@@ -86,6 +99,7 @@ test-fast:
 	$(PY) -m pytest tests/ -q -m "not slow" \
 		--ignore=tests/test_faults.py \
 		--ignore=tests/test_service.py \
+		--ignore=tests/test_obs.py \
 		--ignore=tests/test_pipeline.py \
 		--ignore=tests/test_mesh_pipeline.py
 

@@ -586,10 +586,19 @@ def bench_chunked_round(args) -> dict:
     run = HeavyHittersRun(m, b"bench", {"default": R // 6}, None,
                           verify_key=gen_rand(m.VERIFY_KEY_SIZE),
                           store=store, mesh=mesh)
+    # Same span schema as tools/serve.py epochs and tools/northstar.py
+    # (one "collection" parent, "round"/"chunk.*" children), so a
+    # bench trace and a live-service trace diff directly.
+    from mastic_tpu.obs import trace as obs_trace
+    tracer = obs_trace.get_tracer()
+    coll_span = tracer.start_detached_span(
+        "collection", tool="bench", reports=R, bits=bits)
     t0 = time.perf_counter()
-    while run.step():
-        pass
+    with tracer.use_parent(coll_span):
+        while run.step():
+            pass
     wall = time.perf_counter() - t0
+    tracer.end_span(coll_span)
 
     pipes = [mx.extra["pipeline"] for mx in run.metrics]
     effs = sorted(p["overlap_efficiency"] for p in pipes)

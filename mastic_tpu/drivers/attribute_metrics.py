@@ -15,6 +15,7 @@ from typing import Optional, Sequence
 
 from ..common import gen_rand
 from ..mastic import Mastic
+from ..obs import devtime, trace as obs_trace
 from ..backend.mastic_jax import BatchedMastic
 from .heavy_hitters import run_round
 
@@ -101,12 +102,16 @@ class AttributeMetricsRun:
         self.mesh = mesh
         self.prefixes = prefixes
         self.metrics: list = []
+        self.obs_tenant = ""  # telemetry label (set by the service)
         self.done = False
         self._result: Optional[list] = None
 
     def step(self) -> bool:
         """Run the single aggregation round.  Returns False (no more
-        rounds) — matching the step() contract of HeavyHittersRun."""
+        rounds) — matching the step() contract of HeavyHittersRun.
+        The round runs inside a "round" trace span and feeds the same
+        registry series HeavyHittersRun.step does (obs/devtime), so
+        the two run kinds are diffable in one trace."""
         if self.done:
             return False
         m = self.mastic
@@ -119,20 +124,40 @@ class AttributeMetricsRun:
             # The mesh path needs the padded+masked chunk machinery
             # for uneven report counts — stream as one chunk.
             chunk_size = len(self.reports)
+        profile_dir = devtime.take_profile_dir()
+        prof = None
+        if profile_dir:
+            import jax
+
+            prof = jax.profiler.trace(profile_dir)
         t0 = time.perf_counter()
-        if chunk_size is None:
-            batch = bm.marshal_reports(self.reports)
-            result = run_round(bm, self.verify_key, self.ctx,
-                               agg_param, batch, self.reports,
-                               metrics_out=self.metrics)
-        else:
-            result = _run_round_chunked(
-                bm, self.verify_key, self.ctx, agg_param,
-                self.reports, chunk_size, self.metrics,
-                mesh=self.mesh)
+        if prof is not None:
+            prof.__enter__()
+        try:
+            with obs_trace.get_tracer().span(
+                    "round", tenant=self.obs_tenant, round=0,
+                    level=level, frontier_width=len(self.prefixes),
+                    reports=len(self.reports),
+                    profiled=bool(profile_dir)):
+                if chunk_size is None:
+                    batch = bm.marshal_reports(self.reports)
+                    result = run_round(bm, self.verify_key, self.ctx,
+                                       agg_param, batch, self.reports,
+                                       metrics_out=self.metrics)
+                else:
+                    result = _run_round_chunked(
+                        bm, self.verify_key, self.ctx, agg_param,
+                        self.reports, chunk_size, self.metrics,
+                        mesh=self.mesh)
+        finally:
+            if prof is not None:
+                prof.__exit__(None, None, None)
         if self.metrics:
             self.metrics[-1].extra["round_wall_ms"] = round(
                 (time.perf_counter() - t0) * 1e3, 2)
+            self.metrics[-1].validate_extra()
+            devtime.observe_round(self.metrics[-1],
+                                  tenant=self.obs_tenant)
         self._result = list(zip(self.attributes, result))
         self.done = True
         return False
@@ -334,6 +359,12 @@ def _run_round_chunked(bm: BatchedMastic, verify_key: bytes,
     for rec in timeline:
         (lo, hi) = bounds[rec["chunk"]]
         rec["reports"] = hi - lo
+        # The unified chunk schema (obs/schema.py): every producer
+        # stamps wall_ms — serial and pipelined rounds alike (the
+        # key-set inconsistency ISSUE 7 closes).
+        rec["wall_ms"] = round(
+            max(rec["collect_end_ms"] - rec["stage_start_ms"], 0.0),
+            2)
 
     sched = LevelSchedule(prefixes, level, bm.m.vidpf.BITS)
     checks = {"eval_proof": eval_ok}
@@ -351,6 +382,8 @@ def _run_round_chunked(bm: BatchedMastic, verify_key: bytes,
                  "round_wall_ms": round(wall_ms, 2),
                  "overlap_efficiency": overlap_efficiency(
                      timeline, wall_ms),
+                 "host_syncs": sum(rec["host_syncs"]
+                                   for rec in timeline),
              }}
     if mesh is not None:
         skews = sorted(shard_skews)

@@ -863,6 +863,10 @@ class ChunkedIncrementalRunner(RoundPrograms):
                                                      wall_ms),
             "compile_inline_ms": round(compile_inline_ms, 2),
             "warm_ms": round(warm_spent[0] * 1e3, 2),
+            # One blocking sync per chunk (the executor contract) —
+            # stamped here too so the pipeline block carries the same
+            # key set as the resident producer (obs/schema.py).
+            "host_syncs": sum(rec["host_syncs"] for rec in timeline),
             "aot": self._aot_summary(dev_rows, plan,
                                      compile_inline_ms),
         }

@@ -46,6 +46,9 @@ import time
 from dataclasses import dataclass, field
 from typing import Optional
 
+from ..obs import trace as obs_trace
+from ..obs.registry import get_registry
+
 ACTIONS = ("drop", "delay", "truncate", "corrupt", "duplicate",
            "hang", "kill")
 PARTIES = ("leader", "helper", "collector")
@@ -134,12 +137,20 @@ class FaultInjector:
         """One event of (party, step) happened; the rule whose nth it
         is fires.  Events are counted per step regardless of whether
         any rule fires, so several rules can target different
-        occurrences of the same step."""
+        occurrences of the same step.  A firing rule lands in the
+        trace and the registry BEFORE its action runs, so even a
+        `kill` is visible in the JSONL trace (ISSUE 7: an injected
+        fault must be findable in the telemetry, not inferred)."""
         n = self._event_counts.get(step, 0) + 1
         self._event_counts[step] = n
         for rule in self.rules:
             if rule.step == step and not rule.fired and rule.nth == n:
                 rule.fired = True
+                obs_trace.event("fault_injected", action=rule.action,
+                                party=rule.party, step=step, nth=n)
+                get_registry().counter(
+                    "mastic_faults_injected_total",
+                    action=rule.action, step=step).inc()
                 return rule
         return None
 

@@ -25,6 +25,7 @@ from ..common import gen_rand, vec_add
 from ..mastic import Mastic, ReportRejected
 from ..metrics import (RoundMetrics, attribute_rejections,
                        count_round_bytes, count_round_ops)
+from ..obs import devtime, trace as obs_trace
 from ..backend.mastic_jax import BatchedMastic, ReportBatch
 
 
@@ -323,16 +324,22 @@ class HeavyHittersRun:
         self.heavy_hitters: list = []
         self.metrics: list = []  # one RoundMetrics per completed level
         self.profile_dir: Optional[str] = None  # jax.profiler target
+        self.obs_tenant = ""     # telemetry label (set by the service)
         self.done = False
 
     def step(self) -> bool:
         """Run one level's aggregation round.  Returns True while more
         rounds remain.
 
-        Tracing: when `self.profile_dir` is set (a directory path), the
-        round executes under jax.profiler.trace — open the result with
-        TensorBoard / xprof.  Per-round wall-clock always lands in
-        metrics.extra["round_wall_ms"]."""
+        Telemetry (ISSUE 7): each round runs inside a "round" trace
+        span (attrs: tenant/round/level/frontier_width/reports; chunk
+        spans nest under it) and feeds the chunk-phase histograms +
+        compile-vs-execute attribution (obs/devtime.observe_round).
+        Profiling: when `self.profile_dir` is set (a directory path)
+        — or once per process when `MASTIC_JAX_PROFILE=dir` is armed
+        — the round executes under jax.profiler.trace; open the
+        result with TensorBoard / xprof.  Per-round wall-clock always
+        lands in metrics.extra["round_wall_ms"]."""
         if self.done:
             return False
         if not self.prefixes:
@@ -341,26 +348,35 @@ class HeavyHittersRun:
         level = self.level
         agg_param = (level, tuple(self.prefixes), level == 0)
         assert self.mastic.is_valid(agg_param, self.prev_agg_params)
-        trace = (jax.profiler.trace(self.profile_dir)
-                 if self.profile_dir else None)
+        profile_dir = self.profile_dir or devtime.take_profile_dir()
+        prof = (jax.profiler.trace(profile_dir)
+                if profile_dir else None)
         t0 = time.perf_counter()
-        if trace is not None:
-            trace.__enter__()
+        if prof is not None:
+            prof.__enter__()
         try:
-            if self.runner is not None:
-                agg_result = self.runner.round(agg_param,
-                                               metrics_out=self.metrics)
-            else:
-                agg_result = run_round(self.bm, self.verify_key,
-                                       self.ctx, agg_param, self.batch,
-                                       self.reports,
-                                       metrics_out=self.metrics)
+            with obs_trace.get_tracer().span(
+                    "round", tenant=self.obs_tenant, round=level,
+                    level=level, frontier_width=len(self.prefixes),
+                    reports=self.num_reports,
+                    profiled=bool(profile_dir)):
+                if self.runner is not None:
+                    agg_result = self.runner.round(
+                        agg_param, metrics_out=self.metrics)
+                else:
+                    agg_result = run_round(
+                        self.bm, self.verify_key, self.ctx,
+                        agg_param, self.batch, self.reports,
+                        metrics_out=self.metrics)
         finally:
-            if trace is not None:
-                trace.__exit__(None, None, None)
+            if prof is not None:
+                prof.__exit__(None, None, None)
         if self.metrics:
             self.metrics[-1].extra["round_wall_ms"] = round(
                 (time.perf_counter() - t0) * 1e3, 2)
+            self.metrics[-1].validate_extra()
+            devtime.observe_round(self.metrics[-1],
+                                  tenant=self.obs_tenant)
         self.prev_agg_params.append(agg_param)
 
         survivors = [
@@ -1064,6 +1080,7 @@ class _IncrementalRunner(RoundPrograms):
         metrics.extra["pipeline"] = {
             "mode": "resident-deferred",
             "fallback": None,
+            "round_wall_ms": round((t_host - t0) * 1e3, 2),
             "overlap_efficiency": 0.0,  # one chunk: nothing to overlap
             "compile_inline_ms": round(compile_ms, 2),
             "phases": {

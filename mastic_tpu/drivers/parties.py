@@ -60,6 +60,7 @@ from .. import mastic as mastic_mod
 from ..mastic import Mastic
 from .. import wire
 from ..metrics import RoundMetrics, count_round_bytes
+from ..obs import trace as obs_trace
 from . import faults as faults_mod
 from . import session as session_mod
 from .session import (Channel, Deadline, SessionConfig, SessionError,
@@ -453,7 +454,16 @@ def party_main(argv: list[str]) -> None:
     injector = faults_mod.injector_from_env(me)
 
     def trace(what: str) -> None:
+        # Every step lands as a span event (the party's JSONL trace,
+        # MASTIC_TRACE_FILE, interleaves with the collector's); the
+        # stderr echo stays behind the MASTIC_PARTY_DEBUG lever for
+        # watching a live two-process session by eye.
+        obs_trace.event("party_step", party=me, step=what)
         if debug:
+            # mastic-allow: OB001 — interactive debug lever: the
+            # whole point of MASTIC_PARTY_DEBUG is a human watching
+            # stderr of a live subprocess; the span event above is
+            # the scrapeable record
             print(f"[party {agg_id}] {what}", file=sys.stderr,
                   flush=True)
 

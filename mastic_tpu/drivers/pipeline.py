@@ -48,6 +48,8 @@ from typing import Callable, Optional, Sequence
 
 import jax
 
+from ..obs import trace as obs_trace
+
 
 @contextmanager
 def paused_gc():
@@ -119,9 +121,14 @@ def run_chunks(num_chunks: int, stage: Callable, collect: Callable,
     def now_ms() -> float:
         return (time.perf_counter() - t0) * 1e3
 
+    tracer = obs_trace.get_tracer()
+
     def do_stage(i: int):
         start = now_ms()
-        (handle, phases) = stage(i)
+        # The chunk spans nest under the caller's "round" span, so a
+        # trace reconstructs round -> chunk (ISSUE 7).
+        with tracer.span("chunk.stage", chunk=i):
+            (handle, phases) = stage(i)
         timeline[i] = {
             "chunk": i,
             "stage_start_ms": round(start, 3),
@@ -136,7 +143,8 @@ def run_chunks(num_chunks: int, stage: Callable, collect: Callable,
             before_last_collect()
         rec = timeline[i]
         rec["collect_start_ms"] = round(now_ms(), 3)
-        rec["phases"].update(collect(i, handle))
+        with tracer.span("chunk.collect", chunk=i):
+            rec["phases"].update(collect(i, handle))
         rec["collect_end_ms"] = round(now_ms(), 3)
         # collect() blocks exactly once (jax.block_until_ready on the
         # chunk's full output tree); everything after is ready-data

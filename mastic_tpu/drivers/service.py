@@ -79,6 +79,8 @@ import numpy as np
 
 from .. import wire
 from ..metrics import ServiceCounters
+from ..obs import trace as obs_trace
+from ..obs.registry import get_registry
 from . import faults as faults_mod
 from .session import Deadline, _env_float, _env_int
 from .parties import (REASON_MALFORMED, REASON_NAMES, REASON_RANGE,
@@ -388,7 +390,7 @@ class _Epoch:
     buffer at begin_epoch, plus (once scheduled) the live run."""
 
     __slots__ = ("epoch_id", "pages", "run", "reports", "deadline",
-                 "failures", "started_at", "reports_lost")
+                 "failures", "started_at", "reports_lost", "span")
 
     def __init__(self, epoch_id: int, pages: list):
         self.epoch_id = epoch_id
@@ -399,6 +401,7 @@ class _Epoch:
         self.failures = 0
         self.started_at: Optional[float] = None
         self.reports_lost = 0   # dropped by page-corruption detection
+        self.span = None        # open "epoch" trace span while active
 
     def report_count(self) -> int:
         return sum(p.count for p in self.pages)
@@ -407,7 +410,7 @@ class _Epoch:
 class _Tenant:
     __slots__ = ("spec", "mastic", "open_page", "sealed", "pending",
                  "active", "completed", "counters", "epoch_seq",
-                 "suspended")
+                 "suspended", "last_timeline")
 
     def __init__(self, spec: TenantSpec):
         self.spec = spec
@@ -417,9 +420,13 @@ class _Tenant:
         self.pending: list = []     # [_Epoch] queued, oldest first
         self.active: Optional[_Epoch] = None
         self.completed: list = []   # epoch result records (dicts)
-        self.counters = ServiceCounters()
+        self.counters = ServiceCounters(tenant=spec.name)
+        # Every tenant's Prometheus series exist from boot (at zero)
+        # so a scrape before the first event still sees the family.
+        self.counters.export_registry()
         self.epoch_seq = 0
         self.suspended = False
+        self.last_timeline: Optional[list] = None  # statusz surface
 
     def buffered_reports(self) -> int:
         """Reports the tenant holds admitted-but-unfinished — the
@@ -490,17 +497,23 @@ class CollectorService:
         t = self.tenants[tenant]
         self._checkpoint("admit")
         if t.suspended:
-            t.counters.shed += 1
+            t.counters.inc("shed")
             t.counters.bump_shed("tenant-quarantined")
+            obs_trace.event("shed", tenant=tenant,
+                            reason="tenant-quarantined")
             return (SHED, "tenant-quarantined")
         try:
             decode_upload(t.mastic, blob)
         except (ValueError, EOFError) as exc:
             reason = _decode_reason(exc)
-            t.counters.quarantined += 1
+            t.counters.inc("quarantined")
             t.counters.bump_quarantine(SERVICE_REASON_NAMES[reason])
+            obs_trace.event("quarantine", tenant=tenant,
+                            reason=SERVICE_REASON_NAMES[reason])
             if t.counters.quarantined >= self._quarantine_limit(t):
                 t.suspended = True
+                obs_trace.event("tenant_suspended", tenant=tenant,
+                                quarantined=t.counters.quarantined)
             return (QUARANTINED, SERVICE_REASON_NAMES[reason])
         if t.buffered_reports() >= self._max_buffered(t):
             # oldest-epoch-first may make room by dropping a queued
@@ -508,11 +521,13 @@ class CollectorService:
             # the policy is reject-newest), the incoming upload sheds.
             self._shed(t)
             if t.buffered_reports() >= self._max_buffered(t):
-                t.counters.shed += 1
+                t.counters.inc("shed")
                 t.counters.bump_shed("reject-newest")
+                obs_trace.event("shed", tenant=tenant,
+                                reason="reject-newest")
                 return (SHED, "reject-newest")
         t.open_page.append(blob)
-        t.counters.admitted += 1
+        t.counters.inc("admitted")
         if t.open_page.count >= self._page_size(t):
             self._seal_open_page(t)
         return (ADMITTED, "")
@@ -526,8 +541,11 @@ class CollectorService:
             return None
         victim = t.pending.pop(0)
         lost = victim.report_count()
-        t.counters.shed += lost
+        t.counters.inc("shed", lost)
         t.counters.bump_shed("oldest-epoch-first", lost)
+        obs_trace.event("shed", tenant=t.spec.name,
+                        reason="oldest-epoch-first", reports=lost,
+                        epoch=victim.epoch_id)
         return f"oldest-epoch-first dropped epoch {victim.epoch_id} " \
                f"({lost} reports)"
 
@@ -543,7 +561,7 @@ class CollectorService:
             page.payload = self.injector.on_blob("page_flush",
                                                  page.payload)
         t.sealed.append(page)
-        t.counters.pages_sealed += 1
+        t.counters.inc("pages_sealed")
 
     # -- epochs ----------------------------------------------------
 
@@ -561,7 +579,7 @@ class CollectorService:
             if self._shed(t) is None:
                 # reject-newest: the cut is refused (pages stay
                 # buffered for a later attempt), counted, not silent.
-                t.counters.epochs_refused += 1
+                t.counters.inc("epochs_refused")
                 return None
         epoch = _Epoch(t.epoch_seq, t.sealed)
         t.epoch_seq += 1
@@ -572,26 +590,33 @@ class CollectorService:
     def _build_run(self, t: _Tenant, reports: list) -> CollectionRun:
         spec = t.spec
         if spec.mode == "heavy_hitters":
-            return HeavyHittersRun(
+            run = HeavyHittersRun(
                 t.mastic, spec.ctx, spec.thresholds, reports,
                 verify_key=spec.verify_key,
                 chunk_size=spec.chunk_size, mesh=self.mesh)
-        return AttributeMetricsRun(
-            t.mastic, spec.ctx, spec.attributes, reports,
-            verify_key=spec.verify_key, chunk_size=spec.chunk_size,
-            mesh=self.mesh)
+        else:
+            run = AttributeMetricsRun(
+                t.mastic, spec.ctx, spec.attributes, reports,
+                verify_key=spec.verify_key,
+                chunk_size=spec.chunk_size, mesh=self.mesh)
+        # The run's round spans / registry series carry this tenant.
+        run.obs_tenant = spec.name
+        return run
 
     def _restore_run(self, t: _Tenant, reports: list,
                      blob: bytes) -> CollectionRun:
         spec = t.spec
         if spec.mode == "heavy_hitters":
-            return HeavyHittersRun.from_bytes(
+            run = HeavyHittersRun.from_bytes(
                 t.mastic, spec.ctx, spec.thresholds, reports,
                 spec.verify_key, blob, mesh=self.mesh)
-        return AttributeMetricsRun.from_bytes(
-            t.mastic, spec.ctx, spec.attributes, reports,
-            spec.verify_key, blob, chunk_size=spec.chunk_size,
-            mesh=self.mesh)
+        else:
+            run = AttributeMetricsRun.from_bytes(
+                t.mastic, spec.ctx, spec.attributes, reports,
+                spec.verify_key, blob, chunk_size=spec.chunk_size,
+                mesh=self.mesh)
+        run.obs_tenant = spec.name
+        return run
 
     def _epoch_reports(self, t: _Tenant, epoch: _Epoch) -> list:
         """Decode the epoch's pages into the drivers' report tuples,
@@ -602,11 +627,14 @@ class CollectorService:
         for page in epoch.pages:
             if not page.verify():
                 epoch.reports_lost += page.count
-                t.counters.pages_corrupt += 1
-                t.counters.quarantined += page.count
+                t.counters.inc("pages_corrupt")
+                t.counters.inc("quarantined", page.count)
                 t.counters.bump_quarantine(
                     SERVICE_REASON_NAMES[REASON_PAGE_CORRUPT],
                     page.count)
+                obs_trace.event(
+                    "page_corrupt", tenant=t.spec.name,
+                    epoch=epoch.epoch_id, reports=page.count)
                 continue
             surviving.append(page)
             for blob in page.decode_blobs():
@@ -619,26 +647,29 @@ class CollectorService:
     def _start_epoch(self, t: _Tenant) -> None:
         epoch = t.pending.pop(0)
         self._checkpoint("epoch_start")
+        epoch.span = obs_trace.get_tracer().start_detached_span(
+            "epoch", tenant=t.spec.name, epoch=epoch.epoch_id,
+            reports=epoch.report_count())
         reports = self._epoch_reports(t, epoch)
         if not reports:
             # Every page was corrupt (or the epoch was empty): an
             # immediately-final degraded epoch, counted, not raised.
-            t.counters.epochs_started += 1
-            t.counters.epochs_failed += 1
+            t.counters.inc("epochs_started")
+            t.counters.inc("epochs_failed")
             t.completed.append(self._record(t, epoch, result=[],
                                             truncated=True,
                                             levels=0, error="no "
                                             "surviving reports"))
             return
         epoch.reports = reports
-        t.counters.epochs_started += 1
+        t.counters.inc("epochs_started")
         try:
             epoch.run = self._build_run(t, reports)
         except Exception as exc:
             # Run construction can refuse (e.g. a memory-envelope
             # gate for the tenant's chunk config): a config-sick
             # tenant fails ITS epoch, attributably — not the service.
-            t.counters.epochs_failed += 1
+            t.counters.inc("epochs_failed")
             t.completed.append(self._record(
                 t, epoch, result=[], truncated=True, levels=0,
                 error=f"{type(exc).__name__}: {exc}"))
@@ -664,6 +695,13 @@ class CollectorService:
                                   3)
         if error is not None:
             rec["error"] = error
+        if epoch.span is not None:
+            # The epoch's trace span closes with its outcome; every
+            # round span of the epoch parented to it.
+            epoch.span.set(truncated=truncated, levels=levels,
+                           **({"error": error} if error else {}))
+            obs_trace.get_tracer().end_span(epoch.span)
+            epoch.span = None
         return rec
 
     # -- the scheduler ---------------------------------------------
@@ -689,11 +727,15 @@ class CollectorService:
     def _run_one_round(self, t: _Tenant) -> None:
         epoch = t.active
         self._checkpoint("epoch_round")
+        tracer = obs_trace.get_tracer()
         if epoch.deadline.expired():
             # Graceful degradation: finish at the last completed
             # level; the frontier is correct for every round that ran.
-            t.counters.deadline_misses += 1
-            t.counters.epochs_truncated += 1
+            t.counters.inc("deadline_misses")
+            t.counters.inc("epochs_truncated")
+            if epoch.span is not None:
+                epoch.span.event("deadline_miss",
+                                 levels=epoch.run.rounds_completed())
             t.completed.append(self._record(
                 t, epoch, result=epoch.run.frontier(),
                 truncated=True,
@@ -703,12 +745,16 @@ class CollectorService:
         t0 = time.perf_counter()
         before = len(epoch.run.metrics)
         try:
-            more = epoch.run.step()
+            # The run's own round span (HeavyHittersRun.step /
+            # AttributeMetricsRun.step) parents to this tenant's open
+            # epoch span — NOT to whatever epoch started last.
+            with tracer.use_parent(epoch.span):
+                more = epoch.run.step()
         except Exception as exc:   # supervised: fail the epoch, not
             # the service — other tenants keep their schedule
             epoch.failures += 1
             if epoch.failures > self.config.epoch_retries:
-                t.counters.epochs_failed += 1
+                t.counters.inc("epochs_failed")
                 t.completed.append(self._record(
                     t, epoch, result=epoch.run.frontier(),
                     truncated=True,
@@ -722,22 +768,41 @@ class CollectorService:
                 # pure function of the reports, so the restart is
                 # bit-identical (completed levels recompute; the r8
                 # respawn-and-replay model applied in-process).
+                if epoch.span is not None:
+                    epoch.span.event(
+                        "epoch_retry", attempt=epoch.failures,
+                        cause=f"{type(exc).__name__}: {exc}"[:200])
+                get_registry().counter(
+                    "mastic_session_retries_total",
+                    tenant=t.spec.name).inc()
                 epoch.run = self._build_run(t, epoch.reports)
             return
-        t.counters.rounds += 1
+        t.counters.inc("rounds")
         quantum_ms = (time.perf_counter() - t0) * 1e3
+        reg = get_registry()
         for mx in epoch.run.metrics[before:]:
             round_ms = mx.extra.get("round_wall_ms", 0.0)
+            sched_ms = round(max(0.0, quantum_ms - round_ms), 3)
             mx.extra["service"] = {
                 "tenant": t.spec.name,
                 "epoch": epoch.epoch_id,
-                "sched_overhead_ms": round(
-                    max(0.0, quantum_ms - round_ms), 3),
+                "sched_overhead_ms": sched_ms,
                 "buffered_reports": t.buffered_reports(),
                 "pending_epochs": len(t.pending),
             }
+            # The service block joins the unified extra schema
+            # (re-stamp: the driver already validated its own blocks).
+            mx.validate_extra()
+            reg.counter("mastic_sched_overhead_ms_total",
+                        tenant=t.spec.name).inc(sched_ms)
+            if mx.extra.get("chunks"):
+                t.last_timeline = mx.extra["chunks"]
+        reg.gauge("mastic_buffered_reports",
+                  tenant=t.spec.name).set(t.buffered_reports())
+        reg.gauge("mastic_pending_epochs",
+                  tenant=t.spec.name).set(len(t.pending))
         if not more:
-            t.counters.epochs_completed += 1
+            t.counters.inc("epochs_completed")
             t.completed.append(self._record(
                 t, epoch, result=epoch.run.result(), truncated=False,
                 levels=epoch.run.rounds_completed()))
@@ -775,6 +840,9 @@ class CollectorService:
                 "suspended": t.suspended,
                 "counters": t.counters.as_dict(),
                 "epochs": list(t.completed),
+                # The statusz last-round timeline (per-chunk phases
+                # of the tenant's most recent chunked round).
+                "last_round_timeline": t.last_timeline,
             }
         return out
 
@@ -897,9 +965,14 @@ class CollectorService:
                 int(x) for x in arrays[f"t{i}_state"]]
             t.epoch_seq = seq
             t.suspended = bool(susp)
-            t.counters = ServiceCounters.from_dict(
-                json.loads(arrays[f"t{i}_counters"].tobytes()))
+            restored = json.loads(arrays[f"t{i}_counters"].tobytes())
+            # Pre-ISSUE-7 snapshots carry no tenant label.
+            restored.setdefault("tenant", t.spec.name)
+            t.counters = ServiceCounters.from_dict(restored)
             t.counters.resumes += 1
+            # Republish the persisted totals so the Prometheus series
+            # continue where the crashed process left them.
+            t.counters.export_registry()
             t.completed = json.loads(
                 arrays[f"t{i}_completed"].tobytes())
             t.open_page = get_page(f"t{i}_open")
@@ -923,6 +996,12 @@ class CollectorService:
                         .tobytes())
                     epoch.deadline = Deadline(svc._epoch_deadline(t))
                     epoch.started_at = time.monotonic()
+                    epoch.span = obs_trace.get_tracer() \
+                        .start_detached_span(
+                            "epoch", tenant=t.spec.name,
+                            epoch=epoch.epoch_id,
+                            reports=epoch.report_count(),
+                            resumed=True)
                     t.active = epoch
         return svc
 

@@ -36,6 +36,9 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Optional
 
+from ..obs import trace as obs_trace
+from ..obs.registry import get_registry
+
 # Error kinds a SessionError carries.  `timeout` and `closed` are the
 # retryable transport kinds (the peer may come back after a respawn);
 # `malformed`, `crashed` and `protocol` are terminal for the attempt
@@ -319,7 +322,16 @@ def with_retries(fn: Callable, attempts: int, backoff: float,
     attribution instead of sleeping through it — previously the loop
     slept the FULL exponential backoff even when the deadline had
     less remaining, so a caller's bounded operation could overrun
-    its budget by up to the whole backoff ladder."""
+    its budget by up to the whole backoff ladder.
+
+    Telemetry (ISSUE 7): every retry lands as a `session_retry` span
+    event carrying the cause (party/step/kind/detail), the backoff
+    actually slept and the remaining deadline budget — previously the
+    cause was handed to `on_retry` and then LOST unless that callback
+    kept it; the trace now shows the whole chain
+    (tests/test_faults.py asserts it for an injected-fault round).
+    An exhausted budget emits `session_retry_exhausted` before the
+    attributed failure."""
     attempt = 0
     while True:
         try:
@@ -328,16 +340,32 @@ def with_retries(fn: Callable, attempts: int, backoff: float,
             if not err.retryable() or attempt >= attempts:
                 raise
             pause = backoff * (2 ** attempt)
-            if deadline is not None:
-                rem = deadline.remaining()
-                if rem is not None:
-                    if rem <= 0.0:
-                        raise SessionError(
-                            err.party, err.step, KIND_TIMEOUT,
-                            f"retry budget exhausted after "
-                            f"{attempt + 1} attempt(s); last error: "
-                            f"[{err.kind}] {err.detail}")
-                    pause = min(pause, rem)
+            rem = (deadline.remaining() if deadline is not None
+                   else None)
+            if rem is not None:
+                if rem <= 0.0:
+                    obs_trace.event(
+                        "session_retry_exhausted",
+                        party=err.party, step=err.step,
+                        kind=err.kind, attempts=attempt + 1)
+                    raise SessionError(
+                        err.party, err.step, KIND_TIMEOUT,
+                        f"retry budget exhausted after "
+                        f"{attempt + 1} attempt(s); last error: "
+                        f"[{err.kind}] {err.detail}")
+                pause = min(pause, rem)
+            obs_trace.event(
+                "session_retry", party=err.party, step=err.step,
+                kind=err.kind, detail=err.detail[:200],
+                attempt=attempt + 1, backoff_s=round(pause, 4),
+                deadline_remaining_s=(None if rem is None
+                                      else round(rem, 3)))
+            get_registry().counter("mastic_session_retries_total",
+                                   tenant="").inc()
+            if err.kind == KIND_TIMEOUT:
+                get_registry().counter(
+                    "mastic_session_timeouts_total",
+                    tenant="").inc()
             if on_retry is not None:
                 on_retry(err, attempt)
             time.sleep(pause)

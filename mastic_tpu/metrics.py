@@ -22,11 +22,22 @@ The drivers accumulate these per level; heavy-hitters exposes them as
 (`drivers/service.py`) adds `ServiceCounters` — the per-tenant
 admission / backpressure / epoch ledger, with the same
 never-silent-degradation contract the r8 session counters set.
+
+Since ISSUE 7 both records feed the unified telemetry layer
+(`mastic_tpu/obs/`): `ServiceCounters` increments route through
+`inc()` / the `bump_*` helpers, which mirror into the process-wide
+metrics registry (tenant-labelled Prometheus series), and
+`RoundMetrics.validate_extra()` holds every producer of the
+`extra["chunks"]` / `extra["pipeline"]` / `extra["mesh"]` /
+`extra["service"]` blocks to the ONE versioned schema
+(`obs/schema.py`).
 """
 
 from dataclasses import asdict, dataclass, field
 
 import numpy as np
+
+from .obs.registry import get_registry
 
 
 @dataclass
@@ -64,6 +75,32 @@ class RoundMetrics:
     def as_dict(self) -> dict:
         return asdict(self)
 
+    def validate_extra(self) -> None:
+        """Hold this record's observability blocks to the unified
+        schema and stamp `extra["schema"]` (obs/schema.py).  Every
+        stamping driver calls this before appending the record, so a
+        producer that drifts from the schema fails its own round."""
+        from .obs import schema
+
+        schema.stamp(self.extra)
+
+
+# ServiceCounters field -> (registry series, outcome label).  Fields
+# not listed either have no Prometheus twin or are owned by another
+# producer (`rounds` is fed per round by obs/devtime.observe_round —
+# mirroring it here too would double-count the series).
+_SERVICE_SERIES = {
+    "admitted": ("mastic_reports_admitted_total", None),
+    "pages_sealed": ("mastic_pages_sealed_total", None),
+    "pages_corrupt": ("mastic_pages_corrupt_total", None),
+    "deadline_misses": ("mastic_deadline_misses_total", None),
+    "epochs_completed": ("mastic_epochs_total", "completed"),
+    "epochs_truncated": ("mastic_epochs_total", "truncated"),
+    "epochs_failed": ("mastic_epochs_total", "failed"),
+    "epochs_refused": ("mastic_epochs_total", "refused"),
+    "epochs_started": ("mastic_epochs_total", "started"),
+}
+
 
 @dataclass
 class ServiceCounters:
@@ -73,8 +110,17 @@ class ServiceCounters:
     degradation are surfaced, never silent.  `shed_reasons` /
     `quarantine_reasons` break the totals down by policy / reason
     name (the r8 reason-code taxonomy plus the service's
-    page-corrupt and tenant-quarantined entries)."""
+    page-corrupt and tenant-quarantined entries).
 
+    ISSUE 7: increments route through `inc()` and the `bump_*`
+    helpers, which mirror into the telemetry registry
+    (tenant-labelled `mastic_*` series, exported at `/metrics`); the
+    dataclass remains the snapshot/serialization ledger.
+    `export_registry()` republishes the persisted totals after a
+    snapshot restore so a resumed service's series continue from
+    where the crashed process left them."""
+
+    tenant: str = ""             # registry label; "" = unattributed
     admitted: int = 0
     quarantined: int = 0         # reports refused at the door
     shed: int = 0                # reports dropped by backpressure
@@ -91,13 +137,61 @@ class ServiceCounters:
     quarantine_reasons: dict = field(default_factory=dict)
     shed_reasons: dict = field(default_factory=dict)
 
+    def inc(self, name: str, n: int = 1) -> None:
+        """Increment one counter field, mirroring into the registry
+        when the field has a Prometheus twin (_SERVICE_SERIES)."""
+        setattr(self, name, getattr(self, name) + n)
+        series = _SERVICE_SERIES.get(name)
+        if series is not None:
+            (metric, outcome) = series
+            labels = {"tenant": self.tenant}
+            if outcome is not None:
+                labels["outcome"] = outcome
+            get_registry().counter(metric, **labels).inc(n)
+
     def bump_quarantine(self, reason: str, n: int = 1) -> None:
         self.quarantine_reasons[reason] = \
             self.quarantine_reasons.get(reason, 0) + n
+        get_registry().counter("mastic_reports_quarantined_total",
+                               tenant=self.tenant,
+                               reason=reason).inc(n)
 
     def bump_shed(self, reason: str, n: int = 1) -> None:
         self.shed_reasons[reason] = \
             self.shed_reasons.get(reason, 0) + n
+        get_registry().counter("mastic_reports_shed_total",
+                               tenant=self.tenant,
+                               reason=reason).inc(n)
+
+    def export_registry(self) -> None:
+        """(Re)publish this ledger's totals into the registry —
+        called at tenant construction (so every tenant's series exist
+        from boot, at zero) and after a snapshot restore (so the
+        series continue from the persisted totals instead of
+        restarting at zero)."""
+        reg = get_registry()
+        for (name, (metric, outcome)) in _SERVICE_SERIES.items():
+            labels = {"tenant": self.tenant}
+            if outcome is not None:
+                labels["outcome"] = outcome
+            reg.counter(metric, **labels).set_total(
+                getattr(self, name))
+        for (reason, n) in self.quarantine_reasons.items():
+            reg.counter("mastic_reports_quarantined_total",
+                        tenant=self.tenant,
+                        reason=reason).set_total(n)
+        for (reason, n) in self.shed_reasons.items():
+            reg.counter("mastic_reports_shed_total",
+                        tenant=self.tenant,
+                        reason=reason).set_total(n)
+        reg.counter("mastic_rounds_total",
+                    tenant=self.tenant).set_total(self.rounds)
+        reg.counter("mastic_session_retries_total",
+                    tenant=self.tenant).inc(0)
+        reg.gauge("mastic_buffered_reports",
+                  tenant=self.tenant).set(0)
+        reg.gauge("mastic_pending_epochs",
+                  tenant=self.tenant).set(0)
 
     def as_dict(self) -> dict:
         return asdict(self)

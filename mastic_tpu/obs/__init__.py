@@ -1,0 +1,41 @@
+"""Unified telemetry layer (ISSUE 7): the one observability substrate
+every runtime layer reports through.
+
+Before this package, runtime behavior surfaced as ad-hoc
+`RoundMetrics.extra` dicts stamped with incompatible schemas at three
+layers and printed by whoever remembered to — there was no way to ask
+"why was tenant A's epoch slow" of a running `tools/serve.py` without
+a debugger.  The package is four small, zero-dependency modules:
+
+  trace.py     structured spans: monotonic-clock, parent-linked,
+               tenant/epoch/round/chunk attributed, ring-buffered in
+               memory and appendable as JSONL (`MASTIC_TRACE_FILE`);
+               retry/fault/quarantine events land as span events
+  registry.py  named counters / gauges / histograms with label sets,
+               exported as Prometheus text and a JSON snapshot; label
+               cardinality is capped (overflow counted, never OOM)
+  devtime.py   device-time attribution: the per-chunk phase timeline
+               (upload/compile/dispatch/compute-wait/download/host)
+               becomes histogram observations with a compile-vs-
+               execute split; `MASTIC_JAX_PROFILE=dir` brackets ONE
+               round in jax.profiler trace capture
+  schema.py    the ONE versioned schema for the `extra["chunks"]` /
+               `extra["mesh"]` / `extra["service"]` / `extra["pipeline"]`
+               blocks, validated by `RoundMetrics.validate_extra`
+  statusz.py   the live status surface: a stdlib http.server thread
+               serving /metrics (Prometheus), /statusz (human text)
+               and /varz (JSON), snapshot-under-lock so the single-
+               threaded scheduler never races a scrape
+
+Everything is import-cheap and jax-free at module level (the drivers
+import this on every round); the tracer and registry are process-wide
+singletons so offline bench runs (`bench.py`, `tools/northstar.py`)
+and the live service (`tools/serve.py`) emit the same span schema and
+the same metric names — USAGE.md "Observability" has the lever table
+and curl examples, and `tools/lint.py` check 9 keeps every registered
+metric name documented there.
+"""
+
+from . import schema, trace  # noqa: F401  (re-exported submodules)
+from .registry import get_registry  # noqa: F401
+from .trace import get_tracer  # noqa: F401

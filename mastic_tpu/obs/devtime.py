@@ -1,0 +1,123 @@
+"""Device-time attribution (ISSUE 7 tentpole, part 3).
+
+The pipelined executor already measures a per-chunk phase timeline
+(upload / compile / dispatch / compute-wait / download / host,
+`drivers/pipeline.run_chunks`), but the numbers were buried in
+`extra["chunks"]` and vanished unless a caller printed them.  This
+module turns them into registry observations:
+
+* `observe_round(metrics, tenant=...)` — called by the drivers'
+  step() after each round: every chunk phase lands in the
+  `mastic_chunk_phase_ms{phase=...}` histogram, the round wall in
+  `mastic_round_wall_ms{tenant=...}`, and the compile-vs-execute
+  split in `mastic_device_time_ms_total{kind=compile|execute}` —
+  the datum that drives the AOT work (PAPERS.md "Automatic Full
+  Compilation ... to Cloud TPUs": knowing how much of a round is
+  compile is what justifies compiling ahead);
+
+* `MASTIC_JAX_PROFILE=dir` — an opt-in, one-shot lever: the FIRST
+  round stepped after import runs under `jax.profiler.trace(dir)`
+  (open with TensorBoard/xprof).  One round, not the whole run:
+  profiler overhead and trace size make an always-on capture useless,
+  and one steady-state round is exactly the datum ROADMAP item 3's
+  chip measurement needs.  `take_profile_dir()` consumes the lever;
+  HeavyHittersRun.step / AttributeMetricsRun.step call it when no
+  explicit profile_dir was set.
+"""
+
+import os
+import threading
+from typing import Optional
+
+from .registry import get_registry
+
+# Phases whose wall time is attributed to XLA compile rather than
+# device execution (ProgramCache.get wait + warm time).
+_COMPILE_PHASES = ("compile_ms",)
+_EXECUTE_PHASES = ("dispatch_ms", "compute_wait_ms")
+
+_profile_lock = threading.Lock()
+_profile_consumed = False
+
+
+def take_profile_dir() -> Optional[str]:
+    """The MASTIC_JAX_PROFILE directory, once: the first caller gets
+    it (and brackets its round in jax.profiler.trace), every later
+    call gets None.  Re-arm by restarting the process — the lever is
+    deliberately one-shot per process."""
+    global _profile_consumed
+    path = os.environ.get("MASTIC_JAX_PROFILE")
+    if not path:
+        return None
+    with _profile_lock:
+        if _profile_consumed:
+            return None
+        _profile_consumed = True
+    return path
+
+
+def reset_profile_lever() -> None:
+    """Tests only: re-arm the one-shot."""
+    global _profile_consumed
+    with _profile_lock:
+        _profile_consumed = False
+
+
+def observe_round(metrics, tenant: str = "") -> None:
+    """Feed one RoundMetrics record into the registry: chunk-phase
+    histograms, round wall, compile-vs-execute attribution, and the
+    per-check accept/reject counters.  Cheap (a few dict walks), and
+    tolerant of records stamped by any producer — missing blocks
+    simply contribute nothing."""
+    reg = get_registry()
+    extra = metrics.extra
+    wall = extra.get("round_wall_ms")
+    if wall is None:
+        pipeline = extra.get("pipeline") or {}
+        wall = pipeline.get("round_wall_ms")
+    if wall is not None:
+        reg.histogram("mastic_round_wall_ms",
+                      tenant=tenant).observe(float(wall))
+
+    compile_ms = 0.0
+    execute_ms = 0.0
+    for rec in extra.get("chunks") or ():
+        for (phase, ms) in rec.get("phases", {}).items():
+            reg.histogram("mastic_chunk_phase_ms",
+                          phase=phase[:-3] if phase.endswith("_ms")
+                          else phase).observe(float(ms))
+            if phase in _COMPILE_PHASES:
+                compile_ms += float(ms)
+            elif phase in _EXECUTE_PHASES:
+                execute_ms += float(ms)
+    pipeline = extra.get("pipeline") or {}
+    phases = pipeline.get("phases")
+    if phases:
+        # The resident runner has one phase record per round instead
+        # of per chunk; it feeds the same histograms.
+        for (phase, ms) in phases.items():
+            reg.histogram("mastic_chunk_phase_ms",
+                          phase=phase[:-3] if phase.endswith("_ms")
+                          else phase).observe(float(ms))
+            if phase in _COMPILE_PHASES:
+                compile_ms += float(ms)
+            elif phase in _EXECUTE_PHASES:
+                execute_ms += float(ms)
+    if compile_ms:
+        reg.counter("mastic_device_time_ms_total",
+                    kind="compile").inc(compile_ms)
+    if execute_ms:
+        reg.counter("mastic_device_time_ms_total",
+                    kind="execute").inc(execute_ms)
+
+    reg.counter("mastic_rounds_total", tenant=tenant).inc()
+    reg.counter("mastic_reports_accepted_total",
+                tenant=tenant).inc(metrics.accepted)
+    for (check, n) in (
+            ("eval_proof", metrics.rejected_eval_proof),
+            ("weight_check", metrics.rejected_weight_check),
+            ("joint_rand", metrics.rejected_joint_rand),
+            ("fallback", metrics.rejected_fallback)):
+        if n:
+            reg.counter("mastic_reports_rejected_total",
+                        tenant=tenant, check=check).inc(n)

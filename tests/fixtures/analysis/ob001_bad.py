@@ -1,0 +1,12 @@
+"""OB001 bad fixture: bare prints in library code — stdout AND
+stderr are both invisible to the telemetry layer."""
+
+import sys
+
+
+def noisy_round(level: int) -> int:
+    print(f"starting level {level}")                    # OB001
+    result = level * 2
+    print(f"level done: {result}", file=sys.stderr)     # OB001 too:
+    # stderr is just as unscrapable as stdout
+    return result

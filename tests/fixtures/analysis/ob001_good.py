@@ -1,0 +1,9 @@
+"""OB001 good fixture: diagnostics route through the telemetry
+layer, where they can be scraped, asserted on, and attributed."""
+
+
+def observed_round(level: int, trace, registry) -> int:
+    trace.event("round_start", level=level)
+    result = level * 2
+    registry.counter("mastic_rounds_total", tenant="t").inc()
+    return result

@@ -261,6 +261,43 @@ def test_dropped_upload_ack_is_retried():
         coll.close()
 
 
+def test_retry_chain_lands_in_trace():
+    """ISSUE 7 satellite: an injected-fault exchange's trace shows
+    the whole retry chain — each `session_retry` event carries the
+    cause (party/step/kind), the backoff actually slept, and the
+    remaining round-deadline budget; previously with_retries handed
+    the cause to on_retry and the chain was lost."""
+    from mastic_tpu.obs import trace as obs_trace
+
+    tracer = obs_trace.configure()   # fresh ring for this test
+    try:
+        m = MasticCount(2)
+        reports = _count_reports(m, [(False, True), (True, False)])
+        coll = ProcessCollector(
+            m, COUNT_SPEC, CTX, gen_rand(m.VERIFY_KEY_SIZE),
+            config=CFG_FAST,
+            faults_spec="drop:party=leader:step=upload_ack")
+        try:
+            coll.upload(reports)
+        finally:
+            coll.close()
+        retries = [sp for sp in tracer.spans()
+                   if sp.name == "session_retry"]
+        assert retries, [sp.name for sp in tracer.spans()]
+        ev = retries[0].attrs
+        assert ev["party"] == "leader"
+        assert ev["step"] == "upload_ack"
+        assert ev["kind"] == "timeout"
+        assert ev["attempt"] == 1
+        assert ev["backoff_s"] > 0
+        # the upload's retry ladder shares the round deadline, so
+        # the remaining budget is a real number, already spent down
+        assert 0 < ev["deadline_remaining_s"] \
+            <= CFG_FAST.round_deadline
+    finally:
+        obs_trace.configure()
+
+
 def test_malformed_report_quarantined_not_fatal():
     """A truncated report blob quarantines that report with a reason
     code; the batch survives."""

@@ -1,0 +1,462 @@
+"""Unified telemetry layer (ISSUE 7, mastic_tpu/obs/): span
+mechanics, the metrics registry and its Prometheus export, the extra
+schema gate, the live HTTP status surface, and the two behavioral
+guarantees the tentpole claims — the trace reconstructs the
+epoch -> round -> chunk hierarchy, and aggregates are bit-identical
+with tracing on vs off.
+
+Fast tier throughout (one small service epoch is the heaviest piece);
+run via `make obs-smoke` (wired into `make ci`).
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mastic_tpu.obs import devtime, registry as registry_mod, schema
+from mastic_tpu.obs import trace as trace_mod
+from mastic_tpu.obs.statusz import StatusServer, render_statusz
+
+
+@pytest.fixture()
+def tracer(tmp_path):
+    """A private tracer singleton aimed at a temp JSONL file; the
+    module singleton is restored (unsinked) afterwards."""
+    path = tmp_path / "trace.jsonl"
+    t = trace_mod.configure(trace_file=str(path))
+    yield (t, path)
+    trace_mod.configure(trace_file="")
+
+
+@pytest.fixture()
+def registry():
+    reg = registry_mod.configure(max_label_sets=8)
+    yield reg
+    registry_mod.configure()
+
+
+# -- span mechanics ----------------------------------------------------
+
+def test_span_nesting_and_attributes(tracer):
+    (t, _path) = tracer
+    with t.span("epoch", tenant="a", epoch=0) as ep:
+        with t.span("round", level=3) as rnd:
+            with t.span("chunk.stage", chunk=1) as ch:
+                pass
+    spans = {s.name: s for s in t.spans()}
+    assert spans["round"].parent_id == ep.span_id
+    assert spans["chunk.stage"].parent_id == rnd.span_id
+    assert spans["epoch"].attrs == {"tenant": "a", "epoch": 0}
+    assert spans["chunk.stage"].attrs == {"chunk": 1}
+    # children close before parents; every span got a duration
+    assert all(s.duration_ms is not None for s in spans.values())
+    assert spans["epoch"].duration_ms >= spans["round"].duration_ms
+
+
+def test_span_events_carry_timestamps_and_attrs(tracer):
+    (t, _path) = tracer
+    with t.span("round") as sp:
+        sp.event("retry", cause="timeout", attempt=1)
+        time.sleep(0.002)
+        sp.event("retry", cause="timeout", attempt=2)
+    (e1, e2) = t.spans()[-1].events
+    assert e1["attrs"]["attempt"] == 1
+    assert e2["t_ms"] > e1["t_ms"]
+
+
+def test_event_without_open_span_is_standalone(tracer):
+    (t, _path) = tracer
+    t.event("session_retry", kind="timeout")
+    sp = t.spans()[-1]
+    assert sp.name == "session_retry"
+    assert sp.attrs["standalone_event"] is True
+
+
+def test_detached_span_does_not_capture_siblings(tracer):
+    (t, _path) = tracer
+    ep_a = t.start_detached_span("epoch", tenant="a")
+    ep_b = t.start_detached_span("epoch", tenant="b")
+    with t.use_parent(ep_a):
+        with t.span("round"):
+            pass
+    with t.use_parent(ep_b):
+        with t.span("round"):
+            pass
+    t.end_span(ep_b)
+    t.end_span(ep_a)
+    rounds = [s for s in t.spans() if s.name == "round"]
+    assert [r.parent_id for r in rounds] == [ep_a.span_id,
+                                             ep_b.span_id]
+
+
+def test_ring_buffer_eviction_is_counted():
+    t = trace_mod.Tracer(capacity=3)
+    for i in range(7):
+        with t.span("s", i=i):
+            pass
+    assert len(t.spans()) == 3
+    assert t.dropped() == 4
+    assert t.finished() == 7
+    # the ring keeps the newest spans
+    assert [s.attrs["i"] for s in t.spans()] == [4, 5, 6]
+
+
+def test_jsonl_round_trip_and_tree(tracer):
+    (t, path) = tracer
+    with t.span("epoch", tenant="a"):
+        with t.span("round", level=0):
+            with t.span("chunk.stage", chunk=0):
+                pass
+    spans = trace_mod.read_jsonl(str(path))
+    assert [s["name"] for s in spans] == ["chunk.stage", "round",
+                                          "epoch"]  # finish order
+    tree = trace_mod.build_tree(spans)
+    epoch = tree[None][0]
+    assert epoch["name"] == "epoch"
+    rnd = tree[epoch["span_id"]][0]
+    assert rnd["name"] == "round"
+    assert tree[rnd["span_id"]][0]["name"] == "chunk.stage"
+
+
+def test_jsonl_torn_tail_line_skipped(tmp_path):
+    path = tmp_path / "trace.jsonl"
+    t = trace_mod.Tracer(trace_file=str(path))
+    with t.span("a"):
+        pass
+    with open(path, "a") as f:
+        f.write('{"name": "torn')   # killed mid-write
+    spans = trace_mod.read_jsonl(str(path))
+    assert [s["name"] for s in spans] == ["a"]
+
+
+# -- registry ----------------------------------------------------------
+
+def test_counter_gauge_histogram_values(registry):
+    c = registry.counter("t_total", "help", tenant="a")
+    c.inc()
+    c.inc(4)
+    assert c.value() == 5
+    g = registry.gauge("t_gauge", "help", tenant="a")
+    g.set(7)
+    g.set(3)
+    assert g.value() == 3
+    h = registry.histogram("t_ms", "help", buckets=(10.0, 100.0),
+                           phase="x")
+    h.observe(5.0)
+    h.observe(50.0)
+    h.observe(5000.0)
+    assert h.value() == {"count": 3, "sum": 5055.0}
+
+
+def test_prometheus_text_golden(registry):
+    """Exposition-format golden: HELP/TYPE headers, label quoting,
+    cumulative histogram buckets with +Inf, _sum/_count."""
+    registry.counter("g_total", "a counter", tenant="a").inc(2)
+    h = registry.histogram("g_ms", "a histogram",
+                           buckets=(10.0, 100.0), phase="up")
+    h.observe(5.0)
+    h.observe(50.0)
+    h.observe(5000.0)
+    expected = "\n".join([
+        "# HELP g_ms a histogram",
+        "# TYPE g_ms histogram",
+        'g_ms_bucket{phase="up",le="10"} 1',
+        'g_ms_bucket{phase="up",le="100"} 2',
+        'g_ms_bucket{phase="up",le="+Inf"} 3',
+        'g_ms_sum{phase="up"} 5055',
+        'g_ms_count{phase="up"} 3',
+        "# HELP g_total a counter",
+        "# TYPE g_total counter",
+        'g_total{tenant="a"} 2',
+        "",
+    ])
+    assert registry.prometheus_text() == expected
+
+
+def test_label_cardinality_cap_collapses_to_overflow(registry):
+    for i in range(12):
+        registry.counter("c_total", "h", tenant=f"t{i}").inc()
+    snap = registry.snapshot()["c_total"]
+    assert snap["overflowed"] == 4
+    series = {json.dumps(s["labels"], sort_keys=True): s["value"]
+              for s in snap["series"]}
+    assert series['{"overflow": "true"}'] == 4
+    assert len(snap["series"]) == 9   # 8 real + overflow child
+    over = registry.snapshot()["mastic_obs_label_overflow_total"]
+    assert over["series"][0]["labels"] == {"metric": "c_total"}
+    assert over["series"][0]["value"] == 4
+
+
+def test_declared_names_win_over_adhoc_help(registry):
+    c = registry.counter("mastic_rounds_total", tenant="x")
+    c.inc()
+    text = registry.prometheus_text()
+    assert "# HELP mastic_rounds_total aggregation rounds completed" \
+        in text
+
+
+def test_kind_mismatch_refused(registry):
+    registry.counter("k_total", "h", tenant="a")
+    with pytest.raises(ValueError, match="already registered"):
+        registry.gauge("k_total", "h", tenant="a")
+
+
+# -- the extra schema gate ---------------------------------------------
+
+def _valid_chunk(i=0):
+    return {"chunk": i, "stage_start_ms": 0.0, "stage_end_ms": 1.0,
+            "collect_start_ms": 1.0, "collect_end_ms": 2.0,
+            "phases": {"upload_ms": 0.1, "dispatch_ms": 0.2,
+                       "compute_wait_ms": 0.3, "download_ms": 0.1,
+                       "host_ms": 0.1},
+            "host_syncs": 1, "reports": 4, "wall_ms": 2.0}
+
+
+def test_schema_stamp_accepts_unified_record():
+    extra = {
+        "chunks": [_valid_chunk(0), _valid_chunk(1)],
+        "pipeline": {"mode": "pipelined", "fallback": None,
+                     "round_wall_ms": 4.0,
+                     "overlap_efficiency": 0.4},
+        "mesh": {"report_shards": 2, "psum_bytes_per_round": 128,
+                 "shard_wait_skew_ms_p50": 0.0,
+                 "shard_wait_skew_ms_max": 0.1},
+        "service": {"tenant": "a", "epoch": 0,
+                    "sched_overhead_ms": 0.2,
+                    "buffered_reports": 0, "pending_epochs": 0},
+    }
+    schema.stamp(extra)
+    assert extra["schema"] == schema.SCHEMA_VERSION
+
+
+@pytest.mark.parametrize("mutate,needle", [
+    (lambda e: e["chunks"][0].pop("wall_ms"), "missing wall_ms"),
+    (lambda e: e["chunks"][0]["phases"].pop("host_ms"),
+     "phases: missing host_ms"),
+    (lambda e: e["pipeline"].pop("round_wall_ms"),
+     "pipeline: missing"),
+    (lambda e: e["pipeline"].__setitem__("mode", "warp"),
+     "pipeline.mode"),
+    (lambda e: e["service"].pop("tenant"), "service: missing"),
+])
+def test_schema_rejects_drifted_producers(mutate, needle):
+    extra = {
+        "chunks": [_valid_chunk()],
+        "pipeline": {"mode": "serial", "fallback": "lever-off",
+                     "round_wall_ms": 4.0,
+                     "overlap_efficiency": 0.0},
+        "service": {"tenant": "a", "epoch": 0,
+                    "sched_overhead_ms": 0.2,
+                    "buffered_reports": 0, "pending_epochs": 0},
+    }
+    mutate(extra)
+    with pytest.raises(ValueError, match="schema violation"):
+        schema.stamp(extra)
+
+
+def test_round_metrics_validate_extra_stamps():
+    from mastic_tpu.metrics import RoundMetrics
+
+    mx = RoundMetrics(level=0, frontier_width=2, padded_width=4,
+                      reports_total=3)
+    mx.extra["pipeline"] = {"mode": "serial", "fallback": None,
+                            "round_wall_ms": 1.0,
+                            "overlap_efficiency": 0.0}
+    mx.validate_extra()
+    assert mx.extra["schema"] == schema.SCHEMA_VERSION
+
+
+# -- devtime attribution -----------------------------------------------
+
+def test_observe_round_feeds_histograms_and_split(registry):
+    from mastic_tpu.metrics import RoundMetrics
+
+    mx = RoundMetrics(level=0, frontier_width=2, padded_width=4,
+                      reports_total=8, accepted=7)
+    mx.rejected_eval_proof = 1
+    mx.extra["round_wall_ms"] = 12.0
+    chunk = _valid_chunk()
+    chunk["phases"]["compile_ms"] = 100.0
+    mx.extra["chunks"] = [chunk]
+    devtime.observe_round(mx, tenant="t")
+    assert registry.counter("mastic_rounds_total",
+                            tenant="t").value() == 1
+    assert registry.counter("mastic_reports_accepted_total",
+                            tenant="t").value() == 7
+    assert registry.counter("mastic_reports_rejected_total",
+                            tenant="t",
+                            check="eval_proof").value() == 1
+    assert registry.counter("mastic_device_time_ms_total",
+                            kind="compile").value() == 100.0
+    # execute = dispatch + compute_wait
+    assert registry.counter("mastic_device_time_ms_total",
+                            kind="execute").value() == \
+        pytest.approx(0.5)
+    assert registry.histogram("mastic_round_wall_ms",
+                              tenant="t").value()["count"] == 1
+
+
+def test_jax_profile_lever_is_one_shot(monkeypatch):
+    monkeypatch.setenv("MASTIC_JAX_PROFILE", "/tmp/profdir")
+    devtime.reset_profile_lever()
+    assert devtime.take_profile_dir() == "/tmp/profdir"
+    assert devtime.take_profile_dir() is None
+    devtime.reset_profile_lever()
+
+
+# -- the live status surface over HTTP ---------------------------------
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}",
+                timeout=10) as resp:
+            return (resp.status, resp.read().decode())
+    except urllib.error.HTTPError as exc:   # 404 raises in urllib
+        return (exc.code, exc.read().decode())
+
+
+def _count_reports(m, ctx, values, bits, seed=0):
+    from mastic_tpu.drivers.service import encode_upload
+
+    rng = np.random.default_rng(seed)
+    blobs = []
+    for v in values:
+        alpha = m.vidpf.test_index_from_int(v, bits)
+        nonce = bytes(rng.integers(0, 256, m.NONCE_SIZE,
+                                   dtype="uint8"))
+        rand = bytes(rng.integers(0, 256, m.RAND_SIZE,
+                                  dtype="uint8"))
+        (ps, shares) = m.shard(ctx, (alpha, True), nonce, rand)
+        blobs.append(encode_upload(m, (nonce, ps, shares)))
+    return blobs
+
+
+def test_status_endpoints_during_live_smoke_epoch(registry, tracer):
+    """/metrics and /statusz (and /varz) fetched over real HTTP
+    between scheduler quanta of a live epoch — the snapshot-under-
+    lock contract: the single-threaded scheduler publishes, the
+    server thread only reads."""
+    from mastic_tpu.drivers.service import (CollectorService,
+                                            ServiceConfig, TenantSpec)
+    from mastic_tpu.mastic import MasticCount
+
+    bits = 2
+    m = MasticCount(bits)
+    vk = bytes(range(m.VERIFY_KEY_SIZE))
+    svc = CollectorService(
+        [TenantSpec(name="count",
+                    spec={"class": "MasticCount", "args": [bits]},
+                    ctx=b"obs", verify_key=vk,
+                    thresholds={"default": 2}, chunk_size=2)],
+        config=ServiceConfig(page_size=2, epoch_deadline=600.0))
+    server = StatusServer(port=0).start()
+    try:
+        for blob in _count_reports(m, b"obs", [0, 0, 3, 3], bits):
+            svc.submit("count", blob)
+        svc.submit("count", b"malformed")   # one quarantine
+        svc.begin_epoch("count")
+        server.publish(svc.metrics())
+        fetched_mid_epoch = False
+        while svc.step():
+            server.publish(svc.metrics())
+            (code, text) = _get(server.port, "/metrics")
+            assert code == 200
+            fetched_mid_epoch = True
+        server.publish(svc.metrics())
+        assert fetched_mid_epoch
+
+        (code, metrics_text) = _get(server.port, "/metrics")
+        assert code == 200
+        for needle in (
+                'mastic_reports_admitted_total{tenant="count"} 4',
+                'mastic_reports_quarantined_total'
+                '{tenant="count",reason="malformed"} 1',
+                'mastic_rounds_total{tenant="count"} 2',
+                "mastic_chunk_phase_ms_bucket",
+                'mastic_epochs_total{tenant="count",'
+                'outcome="completed"} 1'):
+            assert needle in metrics_text, (needle, metrics_text)
+
+        (code, statusz) = _get(server.port, "/statusz")
+        assert code == 200
+        assert "tenant count" in statusz
+        assert "admitted=4" in statusz
+
+        (code, varz_text) = _get(server.port, "/varz")
+        varz = json.loads(varz_text)
+        assert varz["service"]["tenants"]["count"]["counters"][
+            "admitted"] == 4
+        assert varz["metrics"]["mastic_rounds_total"]["series"]
+        assert varz["trace"]["finished"] > 0
+
+        (code, _body) = _get(server.port, "/nosuch")
+        assert code == 404
+
+        # the trace reconstructs epoch -> round -> chunk for the
+        # live epoch (the acceptance hierarchy)
+        spans = trace_mod.read_jsonl(str(tracer[1]))
+        epochs = list(trace_mod.walk(spans, "epoch"))
+        rounds = list(trace_mod.walk(spans, "round"))
+        assert len(epochs) == 1 and len(rounds) == 2
+        assert all(r["parent_id"] == epochs[0]["span_id"]
+                   for r in rounds)
+        assert epochs[0]["attrs"]["tenant"] == "count"
+        round_ids = {r["span_id"] for r in rounds}
+        chunks = [s for s in spans
+                  if s["name"].startswith("chunk.")]
+        assert chunks and all(c["parent_id"] in round_ids
+                              for c in chunks)
+    finally:
+        server.stop()
+
+
+def test_render_statusz_empty_snapshot():
+    assert "no snapshot published" in render_statusz({})
+
+
+# -- the headline guarantee: tracing changes nothing -------------------
+
+def test_aggregates_bit_identical_with_tracing_on_vs_off(tmp_path):
+    """The whole telemetry layer is observe-only: a chunked
+    heavy-hitters run with a JSONL sink + registry armed produces
+    bit-identical results, metrics counters and checkpoint state to
+    one with tracing pointed nowhere."""
+    from mastic_tpu.drivers.heavy_hitters import (
+        HeavyHittersRun, get_reports_from_measurements)
+    from mastic_tpu.mastic import MasticCount
+
+    m = MasticCount(3)
+    vk = bytes(range(m.VERIFY_KEY_SIZE))
+    reports = get_reports_from_measurements(
+        m, b"onoff", [((False, True, False), 1),
+                      ((True, True, True), 1),
+                      ((True, True, True), 1)])
+
+    def collect(trace_file):
+        trace_mod.configure(trace_file=trace_file)
+        registry_mod.configure()
+        run = HeavyHittersRun(m, b"onoff", {"default": 2}, reports,
+                              verify_key=vk, chunk_size=2)
+        while run.step():
+            pass
+        counters = [
+            {k: v for (k, v) in mx.as_dict().items() if k != "extra"}
+            for mx in run.metrics]
+        return (run.result(), counters, run.to_bytes())
+
+    (res_on, counters_on, ckpt_on) = collect(
+        str(tmp_path / "on.jsonl"))
+    (res_off, counters_off, ckpt_off) = collect("")
+    trace_mod.configure(trace_file="")
+    registry_mod.configure()
+    assert res_on == res_off
+    assert counters_on == counters_off
+    assert ckpt_on == ckpt_off   # byte-for-byte checkpoint equality
+    # and the traced run really did write spans
+    spans = trace_mod.read_jsonl(str(tmp_path / "on.jsonl"))
+    assert any(s["name"] == "round" for s in spans)

@@ -11,6 +11,8 @@ contract it checks:
   pallasck    PL001-PL004   any file calling pallas_call
   robustness  RB001-RB005   mastic_tpu/drivers/ + tools/serve.py
                             (session layer + collector service)
+  observability OB001       mastic_tpu/ library code (prints must
+                            route through the telemetry layer)
 
 plus the suppression meta-rules AL001 (mastic-allow without a written
 justification) and AL002 (mastic-allow that silences nothing), and
@@ -27,10 +29,12 @@ See USAGE.md ("Static analysis") for the rule table and workflow.
 import json
 import pathlib
 
-from . import dtypes, pallasck, robustness, secretflow, tracesafe
+from . import (dtypes, observability, pallasck, robustness,
+               secretflow, tracesafe)
 from .core import REPO, Finding, load_file
 
-PASSES = (tracesafe, dtypes, secretflow, pallasck, robustness)
+PASSES = (tracesafe, dtypes, secretflow, pallasck, robustness,
+          observability)
 
 DEFAULT_ROOTS = ("mastic_tpu", "tools", "bench.py")
 

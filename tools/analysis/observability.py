@@ -1,0 +1,58 @@
+"""Pass 6 — observability hygiene (ISSUE 7).
+
+Scope: mastic_tpu/ library code.  tools/ and tests/ are exempt (CLIs
+print their JSON lines; tests print diagnostics), and so is
+`mastic_tpu/gen_test_vec.py` (a file-generator CLI that happens to
+live inside the package).
+
+  OB001  a bare `print(` in library code.  The library's output
+         channels are the telemetry layer (`mastic_tpu/obs/`): spans
+         and span events for anything timed or attributed, registry
+         counters for anything counted, `RoundMetrics.extra` for
+         per-round structure.  A print — stdout OR stderr — is
+         invisible to every one of them: it cannot be scraped,
+         asserted on, attributed to a tenant, or found after the
+         process died.  (The lint gate's check 4 only bans *stdout*
+         prints; this rule closes the stderr loophole the r8 party
+         debug logging used.)  Genuinely interactive diagnostics
+         carry an allow naming why the tracer cannot serve them.
+
+Intentional exceptions are suppressed inline with a justified
+`# mastic-allow: OB00x — reason`, same as every other pass.
+"""
+
+import ast
+
+from .core import Finding
+
+PASS_NAME = "observability"
+
+RULES = {
+    "OB001": "bare print() in library code — route through the "
+             "tracer/registry (mastic_tpu/obs/)",
+}
+
+SCOPE_PREFIX = "mastic_tpu/"
+
+# CLI-shaped files inside the package: their stdout IS the interface.
+EXEMPT_FILES = ("mastic_tpu/gen_test_vec.py",)
+
+
+def in_scope(rel: str) -> bool:
+    return rel.startswith(SCOPE_PREFIX) and rel not in EXEMPT_FILES
+
+
+def check(info) -> list:
+    findings: list = []
+    for node in ast.walk(info.tree):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Name) \
+                and node.func.id == "print":
+            findings.append(Finding(
+                "OB001", info.rel, node.lineno,
+                "bare print() in library code — a printed diagnostic "
+                "cannot be scraped, asserted on, or tenant-attributed;"
+                " record a span event (obs.trace.event) or a registry "
+                "counter instead, or allow with the reason the tracer "
+                "cannot serve it"))
+    return findings

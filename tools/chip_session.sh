@@ -85,6 +85,13 @@ run mesh-all python bench.py --chunked-round-only --mesh all
 # (scheduler-overhead numbers for PERF.md).
 run serve-soak python tools/serve.py --soak 120 --bits 4 --reports 32
 
+# 6b. The live status surface on the chip (ISSUE 7): the smoke
+# scenario with --status-port armed self-curls /metrics, /statusz
+# and /varz mid-run and asserts the per-tenant series, so the
+# observability endpoints are proven against real chip rounds (the
+# chunk-phase histograms carry hardware numbers here, not CPU ones).
+run serve-status python tools/serve.py --smoke --status-port 8321
+
 # Every on-chip run persists itself to BENCH_LAST_GOOD; end on the
 # default configuration so the cached record reflects the default
 # levers, not whichever matrix cell happened to run last.

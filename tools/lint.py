@@ -30,7 +30,12 @@ Checks, over mastic_tpu/, tests/, tools/ and the repo-root scripts:
    module set (the modules under `strict = True` with no relaxing
    override).  mypy cannot run in this image, so the two lists had
    started to drift silently; this check makes the drift a lint
-   failure in both directions.
+   failure in both directions;
+9. every metric name the telemetry registry declares
+   (mastic_tpu/obs/registry.py DECLARED) appears in USAGE.md's
+   "Observability" metric table — an operator reading /metrics must
+   be able to look every series up, so a new metric cannot ship
+   undocumented (the metric twin of check 7's lever rule).
 
 Exit status 0 iff clean.  Run via `make lint` / `make ci`.
 """
@@ -387,6 +392,24 @@ def _strict_mypy_modules(ini_path: pathlib.Path = None) -> set:
     return strict
 
 
+def check_metric_docs() -> list:
+    """Check 9: every declared registry series is documented.  The
+    registry module is import-cheap (stdlib only), so importing it to
+    read DECLARED is the same pattern check 5 uses."""
+    sys.path.insert(0, str(REPO))
+    from mastic_tpu.obs.registry import declared_metric_names
+
+    usage = (REPO / "USAGE.md").read_text()
+    problems = []
+    for name in declared_metric_names():
+        if name not in usage:
+            problems.append(
+                f"mastic_tpu/obs/registry.py: metric {name} is "
+                f"declared but not documented in USAGE.md's "
+                f"Observability metric table")
+    return problems
+
+
 def check_mypy_sync() -> list:
     """Check 8: ANNOTATED == mypy.ini's strict module set, so the
     runtime annotation gate (checks 3/5) covers exactly the modules
@@ -421,6 +444,7 @@ def main() -> int:
     problems += check_call_signatures(files)
     problems += check_env_levers()
     problems += check_mypy_sync()
+    problems += check_metric_docs()
     for problem in problems:
         print(problem)
     print(f"lint: {len(files)} files, {len(problems)} problem(s)")

@@ -412,6 +412,15 @@ def main() -> None:
         os.replace(tmp, args.checkpoint)
 
     stamp(f"rounds: threshold={threshold} planted={args.planted}")
+    # The run's round/chunk spans nest under one "collection" span —
+    # the same span schema tools/serve.py's epochs emit, so an offline
+    # northstar trace and a live service trace diff directly
+    # (MASTIC_TRACE_FILE=path captures both as JSONL).
+    from mastic_tpu.obs import trace as obs_trace
+    coll_span = obs_trace.get_tracer().start_detached_span(
+        "collection", tool="northstar", inst=args.inst,
+        reports=R, bits=bits,
+        mode="resident" if args.resident else "chunked")
     agg_t0 = time.time()
     evals_total = 0
     chunk_rates: list = []
@@ -422,7 +431,8 @@ def main() -> None:
         # returns False — consume metrics appended since the last
         # iteration, not just on True returns, or the final level's
         # evals vanish from the totals.
-        more = run.step()
+        with obs_trace.get_tracer().use_parent(coll_span):
+            more = run.step()
         if args.checkpoint and more \
                 and run.level % args.checkpoint_every == 0:
             save_checkpoint()
@@ -445,6 +455,7 @@ def main() -> None:
                       f"evals/s p50={p50:.0f}")
             level += 1
     agg_wall = time.time() - agg_t0
+    obs_trace.get_tracer().end_span(coll_span)
 
     hitters = run.result()
     expected = {tuple(bool(b) for b in row) for row in paths}
@@ -519,6 +530,9 @@ def main() -> None:
         "envelope": envelope,
         "heavy_hitters_found": len(hitters),
         "heavy_hitters_expected": len(expected),
+        # Tracer state: how many spans this run emitted, where the
+        # JSONL (if any) went — so an artifact names its own trace.
+        "obs": obs_trace.get_tracer().snapshot(),
         "ok": got == expected,
     }
     if pipeline_out is not None:

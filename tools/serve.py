@@ -35,6 +35,20 @@ Three modes:
 service arms its injector from the environment, so e.g.
 ``kill:party=collector:step=epoch_round:nth=2`` exercises a real
 process death mid-epoch against the snapshot/resume pair.
+
+Observability (ISSUE 7): ``--status-port N`` starts the live status
+surface (`mastic_tpu/obs/statusz.py`) on 127.0.0.1:N — ``/metrics``
+(Prometheus), ``/statusz`` (human text: per-tenant occupancy, queue
+depths, shed/quarantine totals, last-round timelines) and ``/varz``
+(JSON snapshot).  Port 0 binds an ephemeral port (printed in the JSON
+line as ``status_port``).  The scheduler stays single-threaded: it
+publishes an immutable snapshot after every quantum and the server
+thread only reads published snapshots (snapshot-under-lock).  With
+``--smoke --status-port`` the smoke gate additionally self-fetches
+all three endpoints and asserts the expected per-tenant series are
+present — the `make obs-smoke` cell.  `MASTIC_TRACE_FILE=path` gets
+a JSONL span trace of every epoch/round/chunk (USAGE.md
+"Observability").
 """
 
 import argparse
@@ -92,7 +106,7 @@ def fail(msg: str) -> None:
     sys.exit(1)
 
 
-def drain(svc, snapshot_path=None, deadline=None) -> None:
+def drain(svc, snapshot_path=None, deadline=None, status=None) -> None:
     from mastic_tpu.drivers.session import Deadline
 
     if deadline is None:
@@ -102,8 +116,65 @@ def drain(svc, snapshot_path=None, deadline=None) -> None:
     while svc.step():
         if snapshot_path:
             write_snapshot(svc, snapshot_path)
+        publish_status(status, svc)
         if deadline.expired():
             fail("drain deadline expired with epochs still queued")
+    publish_status(status, svc)
+
+
+def start_status(port):
+    """The --status-port surface, or None when the flag is absent.
+    Port 0 binds an ephemeral port (server.port has the real one)."""
+    if port is None:
+        return None
+    from mastic_tpu.obs.statusz import StatusServer
+
+    return StatusServer(port=port).start()
+
+
+def publish_status(status, svc) -> None:
+    """One scheduler quantum's snapshot to the status server — the
+    single-threaded scheduler's only contact with the server thread
+    (snapshot-under-lock; the server never touches `svc`)."""
+    if status is not None:
+        status.publish(svc.metrics())
+
+
+def check_status_endpoints(status) -> None:
+    """Self-fetch /metrics, /statusz and /varz over real HTTP and
+    assert the series the acceptance criteria name are present (the
+    `make obs-smoke` gate's teeth)."""
+    import urllib.request
+
+    def get(path: str) -> bytes:
+        url = f"http://127.0.0.1:{status.port}{path}"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            if resp.status != 200:
+                fail(f"GET {path} -> {resp.status}")
+            return resp.read()
+
+    metrics = get("/metrics").decode()
+    for needle in (
+            'mastic_reports_admitted_total{tenant="count"}',
+            'mastic_reports_quarantined_total{tenant="count"',
+            'mastic_reports_shed_total{tenant="flood"',
+            'mastic_rounds_total{tenant="count"}',
+            'mastic_session_retries_total{tenant="count"}',
+            "mastic_chunk_phase_ms_bucket",
+            "mastic_epochs_total{",
+            "mastic_round_wall_ms_bucket"):
+        if needle not in metrics:
+            fail(f"/metrics missing expected series {needle!r}")
+    statusz = get("/statusz").decode()
+    for needle in ("tenant count", "occupancy:", "counters:"):
+        if needle not in statusz:
+            fail(f"/statusz missing {needle!r}")
+    varz = json.loads(get("/varz"))
+    for key in ("metrics", "trace", "service"):
+        if key not in varz:
+            fail(f"/varz missing {key!r}")
+    if "count" not in varz["service"].get("tenants", {}):
+        fail("/varz service snapshot has no tenants")
 
 
 def write_snapshot(svc, path: str) -> None:
@@ -133,6 +204,10 @@ def main() -> None:
     parser.add_argument("--soak", type=float, default=0.0,
                         help="unattended soak for SECONDS "
                              "(chip-session cell)")
+    parser.add_argument("--status-port", type=int, default=None,
+                        help="serve /metrics, /statusz and /varz on "
+                             "127.0.0.1:PORT (0 = ephemeral; USAGE.md "
+                             "'Observability')")
     parser.add_argument("--out", type=str, default=None)
     args = parser.parse_args()
 
@@ -158,7 +233,7 @@ def main() -> None:
         mesh = make_mesh(args.mesh, nodes_axis=1)
 
     if args.smoke:
-        run_smoke(args, mesh)
+        run_smoke(args, mesh, status=start_status(args.status_port))
         return
 
     from mastic_tpu.drivers.service import (CollectorService,
@@ -199,6 +274,8 @@ def main() -> None:
                                               mesh=mesh)
     else:
         svc = CollectorService(tenants, config=config, mesh=mesh)
+    status = start_status(args.status_port)
+    publish_status(status, svc)
 
     hot = args.reports // 2
     count_values = [0] * hot + [2 ** bits - 1] * (args.reports - hot)
@@ -209,7 +286,8 @@ def main() -> None:
         + [0] * min(2, args.reports)
 
     if args.soak:
-        run_soak(args, svc, m_count, count_values, rng, t_start)
+        run_soak(args, svc, m_count, count_values, rng, t_start,
+                 status=status)
         return
 
     if not args.resume:
@@ -224,7 +302,7 @@ def main() -> None:
             svc.begin_epoch("attrs")
         if args.snapshot:
             write_snapshot(svc, args.snapshot)
-    drain(svc, snapshot_path=args.snapshot)
+    drain(svc, snapshot_path=args.snapshot, status=status)
     if args.snapshot:
         write_snapshot(svc, args.snapshot)
 
@@ -235,6 +313,7 @@ def main() -> None:
         "bits": bits, "reports": args.reports,
         "epochs": args.epochs,
         "mesh_devices": args.mesh or 1,
+        "status_port": status.port if status is not None else None,
         "wall_seconds": round(time.time() - t_start, 1),
         "results": {name: strip_wall(t["epochs"])
                     for (name, t) in metrics["tenants"].items()},
@@ -248,7 +327,8 @@ def main() -> None:
             f.write(line + "\n")
 
 
-def run_soak(args, svc, m_count, count_values, rng, t_start) -> None:
+def run_soak(args, svc, m_count, count_values, rng, t_start,
+             status=None) -> None:
     """Unattended soak: admit -> epoch -> drain in a loop under one
     deadline; every epoch's output is checked against the expected
     hitters, so a service that degrades mid-soak fails the cell."""
@@ -267,7 +347,8 @@ def run_soak(args, svc, m_count, count_values, rng, t_start) -> None:
         for r in reports:
             svc.submit("count", encode_upload(m_count, r))
         svc.begin_epoch("count")
-        drain(svc, snapshot_path=args.snapshot, deadline=deadline)
+        drain(svc, snapshot_path=args.snapshot, deadline=deadline,
+              status=status)
         recs = svc.metrics()["tenants"]["count"]["epochs"]
         if recs and not recs[-1]["truncated"]:
             epochs += 1
@@ -291,9 +372,12 @@ def run_soak(args, svc, m_count, count_values, rng, t_start) -> None:
         sys.exit(1)
 
 
-def run_smoke(args, mesh) -> None:
+def run_smoke(args, mesh, status=None) -> None:
     """The serve-smoke gate: one process, every defensive behavior
-    demonstrated and asserted (module docstring lists them)."""
+    demonstrated and asserted (module docstring lists them).  With a
+    status server attached (`--status-port`), the three observability
+    endpoints are self-fetched over real HTTP mid-run and their
+    expected per-tenant series asserted (the obs-smoke gate)."""
     import numpy as np
     import jax
 
@@ -348,9 +432,9 @@ def run_smoke(args, mesh) -> None:
     # 1. malformed-upload burst: reason-coded quarantine, tenant-
     # attributed; the other tenants are untouched.
     for blob in (b"", b"\x07garbage", b"\xff" * 40):
-        (status, detail) = svc.submit("count", blob)
-        if status != QUARANTINED:
-            fail(f"malformed blob admitted: {(status, detail)}")
+        (outcome, detail) = svc.submit("count", blob)
+        if outcome != QUARANTINED:
+            fail(f"malformed blob admitted: {(outcome, detail)}")
     qm = svc.metrics()["tenants"]
     if qm["count"]["counters"]["quarantined"] != 3 \
             or qm["count"]["suspended"] \
@@ -420,6 +504,7 @@ def run_smoke(args, mesh) -> None:
     steps = 0
     while svc.step():
         steps += 1
+        publish_status(status, svc)
         if steps == 1:
             # admission while rounds are in flight: lands in the
             # open page, joins the NEXT epoch.
@@ -429,6 +514,11 @@ def run_smoke(args, mesh) -> None:
                       expect=ADMITTED)
         if steps > 200:
             fail("drain did not converge")
+    publish_status(status, svc)
+    if status is not None:
+        # The obs-smoke teeth: fetch all three endpoints over HTTP
+        # during the live process and assert the acceptance series.
+        check_status_endpoints(status)
 
     mx = svc.metrics()["tenants"]
     count_rec = mx["count"]["epochs"][0]
@@ -470,6 +560,7 @@ def run_smoke(args, mesh) -> None:
         "tenants": {name: t["counters"]
                     for (name, t) in mx2.items()},
         "scheduler_rounds": steps,
+        "status_port": status.port if status is not None else None,
         "ok": True,
     }
     line = json.dumps(out)
