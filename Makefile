@@ -7,9 +7,15 @@
 #                smokes; <5 min cold on a 1-CPU host with a warm
 #                compile cache)
 #   make analyze trace-safety / dtype / secret-flow / pallas /
-#                robustness static analyzer (tools/analysis/; rule
-#                table in USAGE.md) — exits non-zero on any
-#                unsuppressed finding
+#                robustness / observability / concurrency static
+#                analyzer (tools/analysis/; rule table in USAGE.md):
+#                per-file passes plus the whole-program layer (call
+#                graph, CC001-CC004 thread/lock discipline,
+#                SF003-SF005 interprocedural secret flow).  Exits
+#                non-zero on any unsuppressed finding OR when the
+#                mastic-allow total exceeds the committed baseline
+#                (tools/analysis/allow_budget.json); writes the
+#                SARIF 2.1.0 log to artifacts/analysis.sarif
 #   make faults  fault-matrix suite for the process-separated
 #                session layer (deadlines, injection, quarantine,
 #                respawn; USAGE.md "Fault model & injection") —
@@ -80,7 +86,7 @@ lint:
 	$(PY) tools/lint.py
 
 analyze:
-	$(PY) -m tools.analysis
+	$(PY) -m tools.analysis --stats --sarif artifacts/analysis.sarif
 
 typecheck:
 	@if $(PY) -c "import mypy" 2>/dev/null; then \

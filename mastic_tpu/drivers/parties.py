@@ -447,7 +447,11 @@ def party_main(argv: list[str]) -> None:
 
     debug = os.environ.get("MASTIC_PARTY_DEBUG") == "1"
 
-    cfg = json.loads(argv[0])
+    # Config arrives on stdin (the collector's private-pipe handoff —
+    # key material must not ride argv, which is world-readable in
+    # /proc/<pid>/cmdline).  An explicit argv blob still wins for
+    # by-hand debugging of a single party.
+    cfg = json.loads(argv[0] if argv else sys.stdin.readline())
     agg_id = cfg["agg_id"]
     me = "leader" if agg_id == 0 else "helper"
     config = SessionConfig.from_env()
@@ -582,6 +586,10 @@ def _party_loop(party: AggregatorParty, coll: Channel,
                                        str(exc))
                 accept &= ~mask
                 checkpoint("confirm_done")
+                # mastic-allow: SF004 — the aggregate share IS this
+                # step's protocol message (the collector decodes it
+                # with wire.decode_agg_share, the codec twin); only
+                # the share bytes the draft specifies cross here
                 coll.send_msg(
                     REPLY_ACK + party.agg_share(agg_param, accept),
                     "agg_share")
@@ -603,6 +611,10 @@ def _party_loop(party: AggregatorParty, coll: Channel,
                 peer.send_msg(resolution, "resolution")
                 bitmap = np.packbits(accept,
                                      bitorder="little").tobytes()
+                # mastic-allow: SF004 — accept bitmap + aggregate
+                # share are this step's protocol message
+                # (wire.decode_agg_share is the codec twin); nothing
+                # beyond the draft's payload crosses here
                 coll.send_msg(
                     REPLY_ACK + bitmap
                     + party.agg_share(agg_param, accept),
@@ -699,14 +711,36 @@ class ProcessCollector:
             env["MASTIC_FAULTS"] = self.faults_spec
         else:
             env.pop("MASTIC_FAULTS", None)
+        # The party config (which binds the VERIFY KEY) crosses on
+        # the child's private stdin pipe, NOT argv: every local user
+        # can read /proc/<pid>/cmdline, so key material in argv was a
+        # real leak (the whole-program SF004 rule found it; this is
+        # the fix).
         self.procs = [
             subprocess.Popen(
-                [sys.executable, "-m", "mastic_tpu.drivers.parties",
-                 json.dumps({**env_cfg, "agg_id": agg_id})],
-                cwd=_repo_root(), env=env,
+                [sys.executable, "-m", "mastic_tpu.drivers.parties"],
+                cwd=_repo_root(), env=env, stdin=subprocess.PIPE,
                 stdout=sys.stderr, stderr=sys.stderr)
             for agg_id in range(2)
         ]
+        for (agg_id, proc) in enumerate(self.procs):
+            blob = (json.dumps({**env_cfg, "agg_id": agg_id})
+                    + "\n").encode()
+            try:
+                # mastic-allow: SF004 — the key-bearing config leaves
+                # the process over the child's PRIVATE stdin pipe
+                # (mode 0600, no /proc exposure) — this IS the
+                # sanctioned replacement for the old argv handoff
+                proc.stdin.write(blob)
+                proc.stdin.flush()
+                proc.stdin.close()
+            except OSError as exc:
+                # A party dead before reading its config: attribute
+                # now instead of waiting out the handshake accept.
+                raise SessionError(
+                    "leader" if agg_id == 0 else "helper", "spawn",
+                    session_mod.KIND_CRASHED,
+                    f"config handoff failed: {exc}")
         chans: dict = {}
         for _ in range(2):
             try:

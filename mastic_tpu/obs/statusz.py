@@ -138,6 +138,10 @@ class StatusServer:
     def start(self) -> "StatusServer":
         self._httpd = ThreadingHTTPServer(
             ("127.0.0.1", self.requested_port), _Handler)
+        # mastic-allow: CC001 — publication handoff: `owner` is
+        # written once, strictly before Thread.start() below, and
+        # never reassigned; the server thread's reads are ordered
+        # after the start() happens-before edge, so no lock is needed
         self._httpd.owner = self  # type: ignore[attr-defined]
         self._httpd.daemon_threads = True
         self.port = self._httpd.server_address[1]
@@ -157,13 +161,18 @@ class StatusServer:
                 self._extra_varz = extra_varz
 
     def snapshot(self) -> dict:
+        # Snapshot OUT, not the guarded reference: the scheduler
+        # swaps whole dicts in publish(), but handing the live object
+        # across the lock boundary would let a future mutation race a
+        # scrape (the r12 docstring promised copy-on-write; the CC003
+        # analyzer rule now enforces the copy).
         with self._lock:
-            return self._snapshot
+            return dict(self._snapshot)
 
     def varz(self) -> dict:
         with self._lock:
             extra = dict(self._extra_varz)
-            snap = self._snapshot
+            snap = dict(self._snapshot)
         return {
             "metrics": self.registry.snapshot(),
             "trace": self.tracer.snapshot(),

@@ -1,0 +1,17 @@
+"""CC001 bad fixture: unlocked mutation of state shared across
+thread roots (push runs on the main thread, _loop on the worker)."""
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.items = []
+        self.thread = threading.Thread(target=self._loop)
+
+    def _loop(self):
+        with self.lock:
+            self.items.pop()
+
+    def push(self, x):
+        self.items.append(x)

@@ -1,0 +1,17 @@
+"""CC001 good fixture: every cross-thread mutation holds the lock."""
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.items = []
+        self.thread = threading.Thread(target=self._loop)
+
+    def _loop(self):
+        with self.lock:
+            self.items.pop()
+
+    def push(self, x):
+        with self.lock:
+            self.items.append(x)

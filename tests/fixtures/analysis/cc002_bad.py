@@ -1,0 +1,17 @@
+"""CC002 bad fixture: the two lock orders invert (ABBA)."""
+import threading
+
+_lock_a = threading.Lock()
+_lock_b = threading.Lock()
+
+
+def forward():
+    with _lock_a:
+        with _lock_b:
+            pass
+
+
+def backward():
+    with _lock_b:
+        with _lock_a:
+            pass

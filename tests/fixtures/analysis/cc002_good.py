@@ -1,0 +1,17 @@
+"""CC002 good fixture: one global acquisition order."""
+import threading
+
+_lock_a = threading.Lock()
+_lock_b = threading.Lock()
+
+
+def forward():
+    with _lock_a:
+        with _lock_b:
+            pass
+
+
+def also_forward():
+    with _lock_a:
+        with _lock_b:
+            pass

@@ -1,0 +1,16 @@
+"""CC003 bad fixture: the lock-guarded dict escapes by reference."""
+import threading
+
+
+class Board:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.table = {}
+
+    def put(self, k, v):
+        with self.lock:
+            self.table[k] = v
+
+    def view(self):
+        with self.lock:
+            return self.table

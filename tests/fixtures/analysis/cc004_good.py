@@ -1,0 +1,14 @@
+"""CC004 good fixture: the blocking wait happens outside the lock."""
+import threading
+import time
+
+
+class Pacer:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.ticks = 0
+
+    def tick(self):
+        with self.lock:
+            self.ticks = self.ticks + 1
+        time.sleep(0.1)

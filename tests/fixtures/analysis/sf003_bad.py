@@ -1,0 +1,5 @@
+"""SF003 bad fixture: a seed-derived value lands in a span event."""
+
+
+def record_round(tracer, seed):
+    tracer.event("round", seed_head=seed[0])

@@ -1,0 +1,5 @@
+"""SF003 good fixture: only the (public) length is recorded."""
+
+
+def record_round(tracer, seed):
+    tracer.event("round", seed_len=len(seed))

@@ -1,0 +1,10 @@
+"""SF004 bad fixture: key-derived bytes cross the wire raw — the
+taint flows through the helper's return (interprocedural)."""
+
+
+def mix(key):
+    return key + b"pad"
+
+
+def push(sock, key):
+    sock.sendall(mix(key))

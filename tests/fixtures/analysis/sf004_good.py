@@ -1,0 +1,6 @@
+"""SF004 good fixture: egress goes through the wire.py codecs."""
+from mastic_tpu import wire
+
+
+def push(sock, key):
+    sock.sendall(wire.frame(key))

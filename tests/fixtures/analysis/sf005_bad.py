@@ -1,0 +1,6 @@
+"""SF005 bad fixture: the backoff pause depends on key bytes."""
+import time
+
+
+def backoff(key):
+    time.sleep(0.1 * key[0])

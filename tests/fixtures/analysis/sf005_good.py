@@ -1,0 +1,7 @@
+"""SF005 good fixture: a fixed backoff schedule."""
+import time
+
+
+def backoff(key):
+    del key
+    time.sleep(0.25)

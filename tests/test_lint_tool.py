@@ -125,6 +125,32 @@ def test_mypy_sync_flags_relaxed_annotated(monkeypatch):
                and "relaxed in mypy.ini" in p for p in problems)
 
 
+# -- check 10: analyzer rule table <-> USAGE.md -----------------------
+
+def test_rule_table_docs_clean_on_repo():
+    assert lint.check_rule_table_docs() == []
+
+
+def test_rule_table_docs_flags_undocumented_rule(monkeypatch):
+    import tools.analysis as analysis
+
+    padded = dict(analysis._RULE_TABLE)
+    padded["ZZ999"] = "a rule the docs have never heard of"
+    monkeypatch.setattr(analysis, "_RULE_TABLE", padded)
+    problems = lint.check_rule_table_docs()
+    assert any("ZZ999" in p and "missing" in p for p in problems)
+
+
+def test_rule_table_docs_flags_stale_row(monkeypatch):
+    import tools.analysis as analysis
+
+    trimmed = {k: v for (k, v) in analysis._RULE_TABLE.items()
+               if k != "CC001"}
+    monkeypatch.setattr(analysis, "_RULE_TABLE", trimmed)
+    problems = lint.check_rule_table_docs()
+    assert any("CC001" in p and "stale" in p for p in problems)
+
+
 # -- the gate itself --------------------------------------------------
 
 def test_repo_lint_is_clean():

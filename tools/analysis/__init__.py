@@ -1,27 +1,45 @@
-"""Static analyzer for the trace-safety / dtype / secret-flow / Pallas
-invariants that make this reproduction's bit-exact crypto survive
-jit + Pallas (run via `make analyze`; part of `make ci`).
+"""Static analyzer for the trace-safety / dtype / secret-flow /
+Pallas / robustness / observability / concurrency invariants that
+make this reproduction's bit-exact crypto survive jit + Pallas and
+its collector service survive a second thread (run via
+`make analyze`; part of `make ci`).
 
-Four passes, each with stable rule IDs, each scoped to the layer whose
-contract it checks:
+Seven passes, each with stable rule IDs, each scoped to the layer
+whose contract it checks:
 
   tracesafe   TS001-TS004   mastic_tpu/ops/, backend/, flp/flp_jax.py
   dtypes      DT001-DT003   mastic_tpu/ops/ (field/AES/Keccak kernels)
   secretflow  SF001-SF002   vidpf.py, mastic.py, aes.py, xof.py
+              SF003-SF005   whole-program: drivers/, obs/,
+                            metrics.py, tools/serve.py
   pallasck    PL001-PL004   any file calling pallas_call
   robustness  RB001-RB005   mastic_tpu/drivers/ + tools/serve.py
-                            (session layer + collector service)
-  observability OB001       mastic_tpu/ library code (prints must
-                            route through the telemetry layer)
+  observability OB001       mastic_tpu/ library code
+  concurrency CC001-CC004   whole-program: drivers/, obs/,
+                            tools/serve.py (threads + locks)
 
 plus the suppression meta-rules AL001 (mastic-allow without a written
 justification) and AL002 (mastic-allow that silences nothing), and
 XX000 (file does not parse).
 
+The whole-program passes (concurrency, secretflow's SF300 series)
+consume one `callgraph.Program` built from the SAME parsed ASTs the
+per-file passes read: every source file is parsed exactly once per
+run and the `FileInfo`s are threaded through all passes (ISSUE 8
+satellite — previously each invocation could re-walk the tree per
+pass).  They resolve best when run over the full default file set;
+a partial path list analyzes a partial program.
+
 Findings are suppressed inline with `# mastic-allow: <ID>[, <ID>] —
 reason`, on the flagged line or as a comment line directly above the
-flagged statement.  There are no file-level exclusions: every accepted
-risk is written down where the code is.
+flagged statement.  There are no file-level exclusions: every
+accepted risk is written down where the code is, and the TOTAL is
+budgeted — `--stats` prints per-rule suppression counts and fails
+when the count exceeds the committed baseline
+(tools/analysis/allow_budget.json), so accepted risk only grows via
+an explicit baseline bump in the diff.  `--sarif PATH` writes the
+findings (suppressed ones included, with their justifications) as a
+SARIF 2.1.0 log for CI artifact upload.
 
 See USAGE.md ("Static analysis") for the rule table and workflow.
 """
@@ -29,14 +47,17 @@ See USAGE.md ("Static analysis") for the rule table and workflow.
 import json
 import pathlib
 
-from . import (dtypes, observability, pallasck, robustness,
-               secretflow, tracesafe)
+from . import (callgraph, concurrency, dtypes, observability,
+               pallasck, robustness, secretflow, tracesafe)
 from .core import REPO, Finding, load_file
+from .sarif import to_sarif
 
 PASSES = (tracesafe, dtypes, secretflow, pallasck, robustness,
-          observability)
+          observability, concurrency)
 
 DEFAULT_ROOTS = ("mastic_tpu", "tools", "bench.py")
+
+BUDGET_FILE = pathlib.Path(__file__).parent / "allow_budget.json"
 
 _RULE_TABLE = {}
 for _p in PASSES:
@@ -61,6 +82,19 @@ def _pass_applies(mod, rel: str, tree) -> bool:
     return mod.in_scope(rel)
 
 
+def load_paths(paths):
+    """Parse every path exactly once: (FileInfos, parse Findings)."""
+    infos = []
+    parse_findings = []
+    for path in paths:
+        info = load_file(pathlib.Path(path))
+        if isinstance(info, Finding):
+            parse_findings.append(info)
+        else:
+            infos.append(info)
+    return (infos, parse_findings)
+
+
 def analyze_paths(paths, only_passes=None, force_scope=False):
     """Run the passes over `paths`.
 
@@ -70,27 +104,42 @@ def analyze_paths(paths, only_passes=None, force_scope=False):
     tests/fixtures/).  Returns (findings, suppressed) where both are
     lists of Finding — `findings` is what gates CI, `suppressed` is
     what inline allows silenced.
+
+    Each file is parsed once; the per-file passes and the
+    whole-program layer (call graph + concurrency + interprocedural
+    secret flow) share the same `FileInfo`s.
     """
     selected = [p for p in PASSES
                 if only_passes is None or p.PASS_NAME in only_passes]
-    findings: list = []
+    (infos, findings) = load_paths(paths)
+    findings = list(findings)
     suppressed: list = []
-    for path in paths:
-        path = pathlib.Path(path)
-        info = load_file(path)
-        if isinstance(info, Finding):
-            findings.append(info)
-            continue
-        raw: list = []
+
+    raw_by_rel = {info.rel: [] for info in infos}
+    for info in infos:
         for mod in selected:
             if force_scope or _pass_applies(mod, info.rel, info.tree):
-                raw += mod.check(info)
-        for f in raw:
+                raw_by_rel[info.rel] += mod.check(info)
+    # The whole-program layer: one Program over the run's files.
+    if any(getattr(mod, "WHOLE_PROGRAM", False) for mod in selected) \
+            and infos:
+        program = callgraph.Program(infos)
+        for mod in selected:
+            if not getattr(mod, "WHOLE_PROGRAM", False):
+                continue
+            for f in mod.check_program(program,
+                                       force_scope=force_scope):
+                if f.rel in raw_by_rel:
+                    raw_by_rel[f.rel].append(f)
+
+    for info in infos:
+        for f in raw_by_rel[info.rel]:
             sup = info.suppression_for(f)
             if sup is None:
                 findings.append(f)
             else:
                 sup.used = True
+                f.sup_reason = sup.reason
                 suppressed.append(f)
         # Suppression hygiene: every allow must carry a reason and
         # actually silence something.
@@ -120,12 +169,56 @@ def _covered(sup, selected) -> bool:
     return any(rid in owned for rid in sup.ids)
 
 
+# -- suppression budget (ISSUE 8 satellite) ---------------------------
+
+def suppression_stats(suppressed) -> dict:
+    per_rule: dict = {}
+    for f in suppressed:
+        per_rule[f.rule] = per_rule.get(f.rule, 0) + 1
+    return {"total": len(suppressed),
+            "per_rule": dict(sorted(per_rule.items()))}
+
+
+def load_budget() -> dict:
+    return json.loads(BUDGET_FILE.read_text())
+
+
+def check_budget(stats: dict, budget: dict) -> list:
+    """Budget violations (strings); empty when within budget.  The
+    gate is on the TOTAL: accepted risk may move between rules
+    without a diff to the baseline, but may only GROW via an explicit
+    baseline bump."""
+    out = []
+    if stats["total"] > budget["total"]:
+        out.append(
+            f"suppression budget exceeded: {stats['total']} "
+            f"mastic-allow'd findings vs committed baseline "
+            f"{budget['total']} (tools/analysis/allow_budget.json) — "
+            f"fix the new findings or bump the baseline in this "
+            f"diff with a justification")
+    return out
+
+
+def _render_stats(stats: dict, budget: dict) -> str:
+    lines = ["suppressions per rule (committed baseline "
+             f"{budget['total']} total):"]
+    base_rules = budget.get("per_rule", {})
+    for (rule, n) in stats["per_rule"].items():
+        base = base_rules.get(rule, 0)
+        delta = n - base
+        mark = "" if delta == 0 else f"  ({delta:+d} vs baseline)"
+        lines.append(f"  {rule}: {n}{mark}")
+    lines.append(f"  total: {stats['total']} / {budget['total']}")
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     import argparse
 
     parser = argparse.ArgumentParser(
         prog="tools.analysis",
-        description="trace-safety / dtype / secret-flow / pallas "
+        description="trace-safety / dtype / secret-flow / pallas / "
+                    "robustness / observability / concurrency "
                     "static analyzer (rules in USAGE.md)")
     parser.add_argument("paths", nargs="*",
                         help="files to analyze (default: mastic_tpu/, "
@@ -138,6 +231,13 @@ def main(argv=None) -> int:
     parser.add_argument("--force-scope", action="store_true",
                         help="apply passes regardless of path scope "
                              "(fixture testing)")
+    parser.add_argument("--sarif", metavar="PATH",
+                        help="write the run (findings + suppressions "
+                             "with justifications) as SARIF 2.1.0")
+    parser.add_argument("--stats", action="store_true",
+                        help="print per-rule mastic-allow counts and "
+                             "fail when the total exceeds the "
+                             "committed allow_budget.json baseline")
     args = parser.parse_args(argv)
 
     files = ([pathlib.Path(p).resolve() for p in args.paths]
@@ -145,15 +245,38 @@ def main(argv=None) -> int:
     (findings, suppressed_list) = analyze_paths(
         files, only_passes=set(args.only) if args.only else None,
         force_scope=args.force_scope)
+
+    stats = suppression_stats(suppressed_list)
+    budget_problems: list = []
+    if args.stats:
+        budget_problems = check_budget(stats, load_budget())
+
+    if args.sarif:
+        reasons = {(f.rel, f.line, f.rule): (f.sup_reason or "")
+                   for f in suppressed_list}
+        log = to_sarif(_RULE_TABLE, findings, suppressed_list,
+                       reasons)
+        out_path = pathlib.Path(args.sarif)
+        out_path.parent.mkdir(parents=True, exist_ok=True)
+        out_path.write_text(json.dumps(log, indent=2) + "\n")
+
     if args.json:
-        print(json.dumps({
+        payload = {
             "findings": [f.as_json() for f in findings],
             "suppressed": [f.as_json() for f in suppressed_list],
             "files": len(files),
-        }, indent=2))
+        }
+        if args.stats:
+            payload["stats"] = stats
+            payload["budget_problems"] = budget_problems
+        print(json.dumps(payload, indent=2))
     else:
         for f in findings:
             print(f.text())
+        if args.stats:
+            print(_render_stats(stats, load_budget()))
+            for problem in budget_problems:
+                print(f"analyze: {problem}")
         print(f"analyze: {len(files)} files, {len(findings)} "
               f"finding(s), {len(suppressed_list)} suppressed")
-    return 1 if findings else 0
+    return 1 if (findings or budget_problems) else 0

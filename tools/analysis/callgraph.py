@@ -1,0 +1,650 @@
+"""Whole-program model for the interprocedural passes (ISSUE 8).
+
+The per-file passes (tracesafe/dtypes/pallasck/...) see one AST at a
+time; the concurrency pass (CC001-CC004) and the whole-program
+secret-flow rules (SF003-SF005) need to know who calls whom, which
+functions run on which thread, and which statements run under which
+lock.  `Program` is that model, built ONCE per analyzer run from the
+same parsed `FileInfo`s every per-file pass consumes (each source
+file is parsed exactly once per run).
+
+What the model resolves — and, just as important, what it knowingly
+does not (the blind spots are documented in USAGE.md):
+
+* **call edges** — bare intra-module calls, `from x import f` calls,
+  module-alias attribute calls (`wire.frame(...)`), `self.m()` /
+  `cls.m()` method calls (following statically-known single bases),
+  locally-constructed receivers (`x = Tracer(); x.span(...)`),
+  receivers stored on `self` by `__init__` (`self._httpd = ...`),
+  and nested `def`s.  Receivers the above cannot type fall back to
+  *method-name dispatch*: the call edges to EVERY known class
+  defining that method name, capped at `DISPATCH_CAP` targets so a
+  generic name (`get`, `close`) does not connect the world.  Dynamic
+  dispatch past the cap, `getattr`, decorators that swap callables,
+  and functions passed as values (callbacks) are NOT followed.
+
+* **thread roots** — `threading.Thread(target=...)` targets, the
+  handler classes of `*HTTPServer`/`*TCPServer` constructions (their
+  `do_*`/`handle*`/`log_message` methods run on server threads), and
+  process entry points (module bodies, which cover the
+  `if __name__ == "__main__"` subprocess entries of parties.py and
+  tools/serve.py).  Every function gets the set of *root groups*
+  that reach it: the main group (module bodies plus API entry points
+  — functions no analyzed code calls), and one group per discovered
+  thread root.
+
+* **lock discipline** — lock identities (module globals and `self.X`
+  attributes assigned from `threading.Lock()`/`RLock()`), the
+  `with <lock>:` regions of every function, and the *inherited* lock
+  set: a function whose every analyzed call site runs under lock L
+  holds L on entry (a must-analysis to fixpoint over the call graph
+  — how `MetricsRegistry._child`'s mutations are recognized as
+  guarded by the caller's `with self._lock`).
+"""
+
+import ast
+
+from .core import dotted
+
+# A method name resolving (by name) to more than this many classes is
+# treated as dynamic dispatch and not followed.
+DISPATCH_CAP = 8
+
+# Names shared with builtin container/str/file methods: an unknown
+# receiver calling one of these is almost always a dict/list/str/file,
+# not the one repo class that happens to define the same name — never
+# name-dispatch them.
+NO_DISPATCH = {"append", "appendleft", "extend", "add", "update",
+               "pop", "popleft", "get", "items", "keys", "values",
+               "setdefault", "clear", "remove", "insert", "sort",
+               "index", "count", "copy", "join", "split", "strip",
+               "encode", "decode", "format", "close", "write",
+               "read", "readline", "flush", "hex", "tobytes",
+               "put", "send", "recv"}
+
+_LOCK_CTORS = {"Lock", "RLock"}
+_MUTABLE_CTORS = {"dict", "list", "set", "deque", "defaultdict",
+                  "OrderedDict", "Counter", "bytearray"}
+
+
+def module_of(rel: str) -> str:
+    """Dotted module name for a repo-relative path; files outside the
+    package roots (fixtures) use their stem."""
+    if rel.endswith(".py"):
+        rel = rel[:-3]
+    if rel.endswith("/__init__"):
+        rel = rel[: -len("/__init__")]
+    if rel.startswith(("mastic_tpu/", "tools/")) or "/" not in rel:
+        return rel.replace("/", ".")
+    return rel.rsplit("/", 1)[1]
+
+
+class FuncNode:
+    """One function scope (module-level def, method, nested def, or
+    the module body pseudo-scope)."""
+
+    __slots__ = ("qual", "module", "rel", "node", "cls", "name",
+                 "is_module", "callees", "callers", "weak_calls")
+
+    def __init__(self, qual, module, rel, node, cls, name,
+                 is_module=False):
+        self.qual = qual
+        self.module = module
+        self.rel = rel
+        self.node = node
+        self.cls = cls            # ClassNode or None
+        self.name = name
+        self.is_module = is_module
+        self.callees: list = []   # (ast.Call, (FuncNode, ...))
+        self.callers: list = []   # (FuncNode, ast.Call)
+        # id(call) of callees resolved only by multi-candidate
+        # method-name dispatch — too coarse for thread reachability
+        # and return-taint lookup (the consumers treat them as
+        # unresolved-but-connected).
+        self.weak_calls: set = set()
+
+    def params(self) -> list:
+        if self.is_module:
+            return []
+        a = self.node.args
+        return [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+
+
+class ClassNode:
+    __slots__ = ("qual", "module", "rel", "name", "node", "methods",
+                 "bases", "attr_classes", "mutable_attrs",
+                 "lock_attrs")
+
+    def __init__(self, qual, module, rel, name, node):
+        self.qual = qual
+        self.module = module
+        self.rel = rel
+        self.name = name
+        self.node = node
+        self.methods: dict = {}       # name -> FuncNode
+        self.bases: list = []         # base-name strings
+        self.attr_classes: dict = {}  # attr -> ClassNode | str (ext)
+        self.mutable_attrs: set = set()   # attrs init'd to containers
+        self.lock_attrs: set = set()      # attrs init'd to Lock()
+
+
+class _Scope:
+    """Iterates one function scope's own statements (nested defs are
+    their own FuncNodes)."""
+
+    @staticmethod
+    def iter(node):
+        stack = list(ast.iter_child_nodes(node))
+        while stack:
+            sub = stack.pop()
+            yield sub
+            if not isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef,
+                                    ast.ClassDef)):
+                stack.extend(ast.iter_child_nodes(sub))
+
+
+class Program:
+    """The whole-program model.  Build once from the run's FileInfos;
+    every whole-program pass consumes the same instance."""
+
+    def __init__(self, infos):
+        self.infos = {info.rel: info for info in infos}
+        self.functions: dict = {}        # qual -> FuncNode
+        self.classes: dict = {}          # qual -> ClassNode
+        self.methods_by_name: dict = {}  # name -> [FuncNode]
+        self.classes_by_name: dict = {}  # bare name -> [ClassNode]
+        # (module, local name) -> ("func"|"class"|"module", qual)
+        self.names: dict = {}
+        self.module_bodies: dict = {}    # module -> FuncNode
+        self.thread_roots: dict = {}     # group id -> [FuncNode]
+        self.roots_of: dict = {}         # qual -> set of group ids
+        self.lock_ids: set = set()
+        self.entry_locks: dict = {}      # qual -> frozenset(lock ids)
+        self._regions_cache: dict = {}
+        self._collect()
+        self._resolve_imports()
+        self._resolve_edges()
+        self._discover_threads()
+        self._reachability()
+        self._lock_fixpoint()
+
+    # -- collection ------------------------------------------------
+
+    def _collect(self) -> None:
+        for info in self.infos.values():
+            mod = module_of(info.rel)
+            body = FuncNode(mod + ".<module>", mod, info.rel,
+                            info.tree, None, "<module>",
+                            is_module=True)
+            self.module_bodies[mod] = body
+            self.functions[body.qual] = body
+            for node in info.tree.body:
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    self._add_function(info, mod, node, None)
+                elif isinstance(node, ast.ClassDef):
+                    self._add_class(info, mod, node)
+        # Second phase: receiver typing needs every class collected
+        # first (cross-module constructor references).
+        for cls in self.classes.values():
+            self._scan_init(cls)
+
+    def _add_class(self, info, mod, node) -> None:
+        qual = f"{mod}.{node.name}"
+        cls = ClassNode(qual, mod, info.rel, node.name, node)
+        cls.bases = [dotted(b) for b in node.bases]
+        self.classes[qual] = cls
+        self.classes_by_name.setdefault(node.name, []).append(cls)
+        self.names[(mod, node.name)] = ("class", qual)
+        for sub in node.body:
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fn = self._add_function(info, mod, sub, cls)
+                cls.methods[sub.name] = fn
+                self.methods_by_name.setdefault(
+                    sub.name, []).append(fn)
+
+    def _add_function(self, info, mod, node, cls, prefix=None):
+        base = prefix or (cls.qual if cls else mod)
+        qual = f"{base}.{node.name}"
+        fn = FuncNode(qual, mod, info.rel, node, cls, node.name)
+        self.functions[qual] = fn
+        if cls is None and prefix is None:
+            self.names[(mod, node.name)] = ("func", qual)
+        # Nested defs become their own scopes, addressable from the
+        # enclosing one (closures like serve.py's put_page).
+        for sub in _Scope.iter(node):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._add_function(info, mod, sub, cls,
+                                   prefix=qual + ".<locals>")
+        return fn
+
+    def _scan_init(self, cls: ClassNode) -> None:
+        """Receiver types, mutable-container attrs and lock attrs a
+        class binds on `self` (any method; __init__ dominates)."""
+        for fn in cls.methods.values():
+            for node in _Scope.iter(fn.node):
+                if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    continue
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                value = node.value
+                for t in targets:
+                    if not (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        continue
+                    attr = t.attr
+                    ctor = self._ctor_name(value)
+                    if ctor is None:
+                        continue
+                    if ctor in _LOCK_CTORS:
+                        cls.lock_attrs.add(attr)
+                        self.lock_ids.add(("attr", cls.qual, attr))
+                    elif ctor in _MUTABLE_CTORS:
+                        cls.mutable_attrs.add(attr)
+                    else:
+                        known = self.classes_by_name.get(ctor)
+                        cls.attr_classes[attr] = (
+                            known[0] if known and len(known) == 1
+                            else ctor)
+                if isinstance(value, (ast.Dict, ast.List, ast.Set)):
+                    for t in targets:
+                        if isinstance(t, ast.Attribute) \
+                                and isinstance(t.value, ast.Name) \
+                                and t.value.id == "self":
+                            cls.mutable_attrs.add(t.attr)
+
+    @staticmethod
+    def _ctor_name(value):
+        if isinstance(value, ast.Call):
+            name = dotted(value.func)
+            return name.rsplit(".", 1)[-1] if name else None
+        return None
+
+    # -- imports ----------------------------------------------------
+
+    def _resolve_imports(self) -> None:
+        # Two sweeps: re-exports (A imports a name B itself imported)
+        # resolve on the second.
+        for _ in range(2):
+            self._import_sweep()
+
+    def _import_sweep(self) -> None:
+        modules = {module_of(rel) for rel in self.infos}
+        for info in self.infos.values():
+            mod = module_of(info.rel)
+            pkg = mod.rsplit(".", 1)[0] if "." in mod else ""
+            for node in ast.walk(info.tree):
+                if isinstance(node, ast.Import):
+                    for alias in node.names:
+                        local = alias.asname or alias.name.split(".")[0]
+                        target = (alias.name if alias.asname
+                                  else alias.name.split(".")[0])
+                        if target in modules:
+                            self.names.setdefault(
+                                (mod, local), ("module", target))
+                        else:
+                            # External module (numpy, json, ...): an
+                            # attribute call on it must NOT fall back
+                            # to method-name dispatch.
+                            self.names.setdefault(
+                                (mod, local), ("extmodule", target))
+                elif isinstance(node, ast.ImportFrom):
+                    target = self._from_target(node, pkg)
+                    if target is None:
+                        continue
+                    for alias in node.names:
+                        if alias.name == "*":
+                            continue
+                        local = alias.asname or alias.name
+                        if f"{target}.{alias.name}" in self.module_bodies \
+                                or (target == ""
+                                    and alias.name in modules):
+                            sub = (f"{target}.{alias.name}"
+                                   if target else alias.name)
+                            self.names.setdefault(
+                                (mod, local), ("module", sub))
+                        elif (target, alias.name) in self.names:
+                            self.names.setdefault(
+                                (mod, local),
+                                self.names[(target, alias.name)])
+
+    @staticmethod
+    def _from_target(node: ast.ImportFrom, pkg: str):
+        if node.level == 0:
+            return node.module or ""
+        parts = pkg.split(".") if pkg else []
+        up = node.level - 1
+        if up > len(parts):
+            return None
+        base = parts[: len(parts) - up] if up else parts
+        if node.module:
+            base = base + node.module.split(".")
+        return ".".join(base)
+
+    # -- call resolution --------------------------------------------
+
+    def _resolve_edges(self) -> None:
+        for fn in list(self.functions.values()):
+            for node in _Scope.iter(fn.node):
+                if isinstance(node, ast.Call):
+                    targets = self.resolve_call(fn, node)
+                    if len(targets) > 1:
+                        fn.weak_calls.add(id(node))
+                    fn.callees.append((node, targets))
+                    for t in targets:
+                        t.callers.append((fn, node))
+
+    def resolve_call(self, fn: FuncNode, call: ast.Call) -> tuple:
+        f = call.func
+        mod = fn.module
+        if isinstance(f, ast.Name):
+            nested = self.functions.get(
+                f"{fn.qual}.<locals>.{f.id}")
+            if nested is not None:
+                return (nested,)
+            hit = self.names.get((mod, f.id))
+            if hit is None:
+                return ()
+            (kind, qual) = hit
+            if kind == "func":
+                t = self.functions.get(qual)
+                return (t,) if t else ()
+            if kind == "class":
+                cls = self.classes.get(qual)
+                init = cls.methods.get("__init__") if cls else None
+                return (init,) if init else ()
+            return ()
+        if not isinstance(f, ast.Attribute):
+            return ()
+        attr = f.attr
+        base = f.value
+        # module alias:  wire.frame(...)
+        if isinstance(base, ast.Name):
+            hit = self.names.get((mod, base.id))
+            if hit is not None and hit[0] == "extmodule":
+                return ()
+            if hit is not None and hit[0] == "module":
+                t = self.names.get((hit[1], attr))
+                if t and t[0] == "func":
+                    fnode = self.functions.get(t[1])
+                    return (fnode,) if fnode else ()
+                if t and t[0] == "class":
+                    cls = self.classes.get(t[1])
+                    init = (cls.methods.get("__init__")
+                            if cls else None)
+                    return (init,) if init else ()
+                return ()
+            if base.id in ("self", "cls") and fn.cls is not None:
+                m = self._method_in(fn.cls, attr)
+                if m is not None:
+                    return (m,)
+                return self._dispatch(attr)
+        cls = self.receiver_class(fn, base)
+        if isinstance(cls, ClassNode):
+            m = self._method_in(cls, attr)
+            if m is not None:
+                return (m,)
+        return self._dispatch(attr)
+
+    def _method_in(self, cls: ClassNode, name: str):
+        seen = set()
+        stack = [cls]
+        while stack:
+            c = stack.pop()
+            if c.qual in seen:
+                continue
+            seen.add(c.qual)
+            if name in c.methods:
+                return c.methods[name]
+            for b in c.bases:
+                bn = b.rsplit(".", 1)[-1]
+                for cand in self.classes_by_name.get(bn, []):
+                    stack.append(cand)
+        return None
+
+    def _dispatch(self, attr: str) -> tuple:
+        if attr in NO_DISPATCH:
+            return ()
+        cands = self.methods_by_name.get(attr, [])
+        if 0 < len(cands) <= DISPATCH_CAP:
+            return tuple(cands)
+        return ()
+
+    def receiver_class(self, fn: FuncNode, expr):
+        """Best-effort class of a receiver expression: a local bound
+        to a known constructor, or a `self.attr` the class's __init__
+        typed.  Returns ClassNode, an external-ctor name string, or
+        None."""
+        if isinstance(expr, ast.Name):
+            for node in _Scope.iter(fn.node):
+                if isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name) \
+                        and node.targets[0].id == expr.id:
+                    ctor = self._ctor_name(node.value)
+                    if ctor:
+                        known = self.classes_by_name.get(ctor)
+                        if known and len(known) == 1:
+                            return known[0]
+                        return ctor
+            return None
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self" and fn.cls is not None:
+            return fn.cls.attr_classes.get(expr.attr)
+        return None
+
+    # -- thread roots -----------------------------------------------
+
+    def _discover_threads(self) -> None:
+        for fn in list(self.functions.values()):
+            for (call, _t) in fn.callees:
+                name = dotted(call.func)
+                tail = name.rsplit(".", 1)[-1]
+                if tail == "Thread":
+                    self._thread_target(fn, call)
+                elif tail.endswith(("HTTPServer", "TCPServer",
+                                    "UDPServer")):
+                    self._server_handlers(fn, call)
+
+    def _thread_target(self, fn: FuncNode, call: ast.Call) -> None:
+        target = None
+        for kw in call.keywords:
+            if kw.arg == "target":
+                target = kw.value
+        if target is None:
+            return
+        resolved = ()
+        if isinstance(target, ast.Name):
+            hit = self.names.get((fn.module, target.id))
+            if hit and hit[0] == "func":
+                t = self.functions.get(hit[1])
+                resolved = (t,) if t else ()
+        elif isinstance(target, ast.Attribute):
+            base = target.value
+            if isinstance(base, ast.Name) and base.id == "self" \
+                    and fn.cls is not None:
+                m = self._method_in(fn.cls, target.attr)
+                resolved = (m,) if m else ()
+            else:
+                cls = self.receiver_class(fn, base)
+                if isinstance(cls, ClassNode):
+                    m = self._method_in(cls, target.attr)
+                    resolved = (m,) if m else ()
+                # A target on an external server object (e.g.
+                # `self._httpd.serve_forever`): the serving work is
+                # the handler class, found by _server_handlers.
+        for t in resolved:
+            self.thread_roots.setdefault(
+                f"thread:{t.qual}", []).append(t)
+
+    def _server_handlers(self, fn: FuncNode, call: ast.Call) -> None:
+        """`ThreadingHTTPServer(addr, Handler)` — the handler class's
+        entry methods run on server threads."""
+        for arg in call.args[1:2]:
+            if not isinstance(arg, ast.Name):
+                continue
+            hit = self.names.get((fn.module, arg.id))
+            if not (hit and hit[0] == "class"):
+                continue
+            cls = self.classes.get(hit[1])
+            if cls is None:
+                continue
+            group = f"thread:{cls.qual}"
+            for (name, m) in cls.methods.items():
+                if name.startswith(("do_", "handle")) \
+                        or name == "log_message":
+                    self.thread_roots.setdefault(group, []).append(m)
+
+    # -- reachability -----------------------------------------------
+
+    def _reach(self, seeds, strong_only: bool = False) -> set:
+        seen = set()
+        stack = [s.qual for s in seeds]
+        while stack:
+            q = stack.pop()
+            if q in seen:
+                continue
+            seen.add(q)
+            fn = self.functions.get(q)
+            if fn is None:
+                continue
+            for (call, targets) in fn.callees:
+                if strong_only and id(call) in fn.weak_calls:
+                    continue
+                for t in targets:
+                    if t.qual not in seen:
+                        stack.append(t.qual)
+        return seen
+
+    def _reachability(self) -> None:
+        thread_fns = {t.qual for roots in self.thread_roots.values()
+                      for t in roots}
+        handler_classes = set()
+        for (group, roots) in self.thread_roots.items():
+            for t in roots:
+                if t.cls is not None and group.endswith(t.cls.qual):
+                    handler_classes.add(t.cls.qual)
+        main_seeds = list(self.module_bodies.values())
+        for fn in self.functions.values():
+            if fn.is_module or fn.qual in thread_fns:
+                continue
+            if fn.cls is not None and fn.cls.qual in handler_classes:
+                continue
+            if not fn.callers:
+                main_seeds.append(fn)   # API entry: only tests/main
+                #                         call it -> main thread
+        groups = {"main": self._reach(main_seeds)}
+        # Thread-side reachability follows only STRONG edges: a
+        # multi-candidate name dispatch from a handler would otherwise
+        # pull half the program onto the server thread.
+        for (group, roots) in self.thread_roots.items():
+            groups[group] = self._reach(roots, strong_only=True)
+        self.roots_of = {}
+        for (group, quals) in groups.items():
+            for q in quals:
+                self.roots_of.setdefault(q, set()).add(group)
+
+    def root_groups(self, fn: FuncNode) -> set:
+        return self.roots_of.get(fn.qual, set())
+
+    # -- locks -------------------------------------------------------
+
+    def find_locks(self) -> None:
+        """Module-global locks (NAME = threading.Lock())."""
+        for (mod, body) in self.module_bodies.items():
+            for node in body.node.body:
+                if isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    ctor = self._ctor_name(node.value)
+                    if ctor in _LOCK_CTORS:
+                        self.lock_ids.add(
+                            ("global", mod, node.targets[0].id))
+
+    def lock_id_of(self, fn: FuncNode, expr):
+        """The lock identity a `with <expr>:` guards, or None."""
+        if isinstance(expr, ast.Name):
+            lid = ("global", fn.module, expr.id)
+            return lid if lid in self.lock_ids else None
+        if isinstance(expr, ast.Attribute):
+            base = expr.value
+            if isinstance(base, ast.Name) and base.id == "self" \
+                    and fn.cls is not None \
+                    and expr.attr in fn.cls.lock_attrs:
+                return ("attr", fn.cls.qual, expr.attr)
+            cls = self.receiver_class(fn, base)
+            if isinstance(cls, ClassNode) \
+                    and expr.attr in cls.lock_attrs:
+                return ("attr", cls.qual, expr.attr)
+            # Unknown receiver but the attr is SOME class's lock:
+            # resolve only when unambiguous across the program.
+            owners = [c for c in self.classes.values()
+                      if expr.attr in c.lock_attrs]
+            if len(owners) == 1:
+                return ("attr", owners[0].qual, expr.attr)
+        return None
+
+    def with_regions(self, fn: FuncNode) -> list:
+        """(lock id, With node) for every lock-guarded region of this
+        scope (cached — the lock fixpoint and the concurrency pass
+        query it per statement)."""
+        cached = self._regions_cache.get(fn.qual)
+        if cached is not None:
+            return cached
+        out = []
+        for node in _Scope.iter(fn.node):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    lid = self.lock_id_of(fn, item.context_expr)
+                    if lid is not None:
+                        out.append((lid, node))
+        self._regions_cache[fn.qual] = out
+        return out
+
+    def locks_held_at(self, fn: FuncNode, node) -> set:
+        """Locks held at `node`: enclosing with-regions plus the
+        function's inherited entry locks."""
+        held = set(self.entry_locks.get(fn.qual, frozenset()))
+        line = getattr(node, "lineno", None)
+        if line is None:
+            return held
+        for (lid, region) in self.with_regions(fn):
+            if region.lineno <= line <= getattr(
+                    region, "end_lineno", region.lineno):
+                held.add(lid)
+        return held
+
+    def _lock_fixpoint(self) -> None:
+        """Must-analysis: a function whose EVERY analyzed call site
+        runs under lock L holds L on entry.  Entries (module bodies,
+        thread roots, API entry points) start at the empty set;
+        everything else starts at the universe and intersects down."""
+        self.find_locks()
+        universe = frozenset(self.lock_ids)
+        self.entry_locks = {}
+        for fn in self.functions.values():
+            entry = fn.is_module or not fn.callers
+            self.entry_locks[fn.qual] = (frozenset() if entry
+                                         else universe)
+        for t in (r for roots in self.thread_roots.values()
+                  for r in roots):
+            self.entry_locks[t.qual] = frozenset()
+        for _ in range(12):
+            changed = False
+            for fn in self.functions.values():
+                if not fn.callers or fn.is_module:
+                    continue
+                acc = None
+                for (caller, call) in fn.callers:
+                    held = frozenset(
+                        self.locks_held_at(caller, call))
+                    acc = held if acc is None else (acc & held)
+                acc = acc if acc is not None else frozenset()
+                if acc != self.entry_locks[fn.qual]:
+                    self.entry_locks[fn.qual] = acc
+                    changed = True
+            if not changed:
+                break

@@ -1,0 +1,437 @@
+"""Pass 7 — thread/lock discipline over the service plane (ISSUE 8).
+
+Scope: mastic_tpu/obs/ + mastic_tpu/drivers/ + tools/serve.py — the
+layer that grew a second thread in r12 (the `--status-port` server)
+next to the single-threaded scheduler, with shared mutable state
+(registry, tracer ring, published status snapshots) whose safety the
+code comments only *promise*.  This pass consumes the whole-program
+model (`callgraph.Program`): thread-rooted reachability says which
+functions run on which thread, the lock model says which statements
+run under which lock (including locks inherited from every call
+site), and the rules check the promises:
+
+  CC001  unlocked cross-thread mutation: a write to state reachable
+         from more than one thread root (an instance attribute of a
+         class whose methods span thread roots, or a module global
+         read by another thread) performed while holding no lock.
+         Constructors are exempt (the object is unpublished);
+         publish-before-start handoffs carry an allow naming the
+         happens-before edge.
+
+  CC002  lock acquisition order inversion: lock B acquired (directly
+         or via a callee) while holding A somewhere, and A acquired
+         while holding B somewhere else — the classic ABBA deadlock
+         shape, flagged at both acquisition sites.
+
+  CC003  publishing a mutable object instead of a snapshot across
+         the lock boundary: a `with <lock>:` region that returns (or
+         binds-then-returns) a container-valued attribute without
+         copying it — the caller ends up sharing the very object the
+         lock guards, so the guard protects nothing after the
+         return.  `dict(...)/list(...)/.copy()/sorted(...)` wrappers
+         are the sanctioned snapshot forms.
+
+  CC004  blocking while holding a lock: a sleep / socket op / join /
+         wait / file open inside a lock region (directly or in a
+         function that inherits the lock from every call site) —
+         every other thread needing the lock stalls behind I/O.
+
+Known blind spots (shared with the call-graph model, USAGE.md):
+dynamic dispatch past the resolution cap, getattr, callables passed
+as values, and locks threaded through parameters.  Intentional
+exceptions are suppressed inline with a justified
+`# mastic-allow: CC00x — reason`, same as every other pass.
+"""
+
+import ast
+
+from .core import Finding, dotted
+from .callgraph import ClassNode, _Scope
+
+PASS_NAME = "concurrency"
+WHOLE_PROGRAM = True
+
+RULES = {
+    "CC001": "unlocked mutation of state shared across thread roots",
+    "CC002": "lock acquisition order inversion (ABBA deadlock shape)",
+    "CC003": "lock-guarded mutable attribute published without a "
+             "snapshot copy",
+    "CC004": "blocking call while holding a lock",
+}
+
+SCOPE_PREFIXES = ("mastic_tpu/obs/", "mastic_tpu/drivers/")
+EXTRA_FILES = ("tools/serve.py",)
+
+_CTOR_EXEMPT = ("__init__", "__post_init__")
+
+_MUTATING_METHODS = {"append", "extend", "add", "update", "insert",
+                     "remove", "discard", "pop", "popleft", "clear",
+                     "setdefault", "appendleft"}
+
+_COPY_CALLS = {"dict", "list", "tuple", "set", "frozenset", "sorted",
+               "copy", "deepcopy", "bytes"}
+
+_BLOCKING_ATTRS = {"sleep", "accept", "recv", "recv_into", "sendall",
+                   "sendto", "connect", "create_connection",
+                   "makefile", "join", "wait", "communicate",
+                   "urlopen", "serve_forever", "readline", "read"}
+_BLOCKING_NAMES = {"sleep", "open", "create_connection", "urlopen"}
+
+
+def in_scope(rel: str) -> bool:
+    return rel.startswith(SCOPE_PREFIXES) or rel in EXTRA_FILES
+
+
+def check(info) -> list:
+    """Per-file entry point kept for interface symmetry; the real
+    work happens in check_program (the driver calls it once with the
+    run's Program)."""
+    return []
+
+
+def check_program(program, force_scope: bool = False) -> list:
+    findings: list = []
+    _check_cc001(program, findings)
+    _check_cc002(program, findings)
+    _check_cc003(program, findings)
+    _check_cc004(program, findings)
+    if not force_scope:
+        findings = [f for f in findings if in_scope(f.rel)]
+    seen = set()
+    out = []
+    for f in findings:
+        if f.key() in seen:
+            continue
+        seen.add(f.key())
+        out.append(f)
+    return out
+
+
+# -- CC001: shared-state mutation without the lock --------------------
+
+class _Access:
+    __slots__ = ("fn", "node", "attr", "cls", "is_write", "locked")
+
+    def __init__(self, fn, node, attr, cls, is_write, locked):
+        self.fn = fn
+        self.node = node
+        self.attr = attr
+        self.cls = cls          # ClassNode | str (external) | None
+        self.is_write = is_write
+        self.locked = locked
+
+
+def _attr_accesses(program, fn):
+    """Attribute reads/writes of one function scope, with best-effort
+    receiver classes.  Method accesses (the .func of a Call) are
+    calls, not state reads."""
+    write_targets = set()
+    call_funcs = set()
+    out = []
+    for node in _Scope.iter(fn.node):
+        if isinstance(node, ast.Call):
+            call_funcs.add(id(node.func))
+            f = node.func
+            if isinstance(f, ast.Attribute) \
+                    and f.attr in _MUTATING_METHODS \
+                    and isinstance(f.value, ast.Attribute):
+                out.append(_mk_access(program, fn, f.value,
+                                      is_write=True))
+        elif isinstance(node, (ast.Assign, ast.AugAssign,
+                               ast.AnnAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                for sub in ast.walk(t):
+                    if isinstance(sub, ast.Attribute):
+                        write_targets.add(id(sub))
+                        out.append(_mk_access(program, fn, sub,
+                                              is_write=True))
+                        break   # the outermost attribute is the write
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Attribute):
+                    out.append(_mk_access(program, fn, t,
+                                          is_write=True))
+    for node in _Scope.iter(fn.node):
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.ctx, ast.Load) \
+                and id(node) not in call_funcs:
+            out.append(_mk_access(program, fn, node, is_write=False))
+    return [a for a in out if a is not None]
+
+
+def _mk_access(program, fn, attr_node, is_write):
+    base = attr_node.value
+    cls = None
+    if isinstance(base, ast.Name) and base.id in ("self", "cls"):
+        cls = fn.cls
+    else:
+        cls = program.receiver_class(fn, base)
+    # Accessing a method name is a bound-method read, not state.
+    if isinstance(cls, ClassNode) and attr_node.attr in cls.methods:
+        return None
+    locked = bool(program.locks_held_at(fn, attr_node))
+    return _Access(fn, attr_node, attr_node.attr, cls, is_write,
+                   locked)
+
+
+def _compatible(a: _Access, b: _Access) -> bool:
+    """Two accesses may touch the same state: same known class, or at
+    least one receiver unresolved (the conservative match the
+    statusz `owner` handoff needs)."""
+    if isinstance(a.cls, ClassNode) and isinstance(b.cls, ClassNode):
+        return a.cls.qual == b.cls.qual
+    return True
+
+
+def _check_cc001(program, findings) -> None:
+    by_attr: dict = {}
+    for fn in program.functions.values():
+        if fn.is_module:
+            continue
+        groups = program.root_groups(fn)
+        if not groups:
+            continue
+        for acc in _attr_accesses(program, fn):
+            by_attr.setdefault(acc.attr, []).append((acc, groups))
+    for (attr, entries) in by_attr.items():
+        all_groups = set()
+        for (_acc, groups) in entries:
+            all_groups |= groups
+        if len(all_groups) < 2:
+            continue
+        for (acc, groups) in entries:
+            if not acc.is_write or acc.locked:
+                continue
+            if acc.fn.name in _CTOR_EXEMPT:
+                continue
+            # Cross-thread only if some COMPATIBLE access runs under
+            # a root group this write's function does not.
+            foreign = [o for (o, og) in entries
+                       if o is not acc and _compatible(acc, o)
+                       and (og - groups)]
+            if not foreign:
+                continue
+            other = foreign[0]
+            findings.append(Finding(
+                "CC001", acc.fn.rel, acc.node.lineno,
+                f"unlocked write to '{attr}' shared across thread "
+                f"roots (also touched by {other.fn.qual}, reachable "
+                f"from {sorted(program.root_groups(other.fn))[0]}) — "
+                f"hold the owning lock, or allow naming the "
+                f"happens-before edge"))
+    _check_cc001_globals(program, findings)
+
+
+def _check_cc001_globals(program, findings) -> None:
+    """Module globals written via `global` off one root and read from
+    another, unlocked."""
+    decls: dict = {}   # (module, name) -> [(fn, node, locked, groups)]
+    reads: dict = {}
+    for fn in program.functions.values():
+        if fn.is_module:
+            continue
+        groups = program.root_groups(fn)
+        if not groups:
+            continue
+        globals_here = set()
+        for node in _Scope.iter(fn.node):
+            if isinstance(node, ast.Global):
+                globals_here.update(node.names)
+        for node in _Scope.iter(fn.node):
+            if not isinstance(node, ast.Name):
+                continue
+            key = (fn.module, node.id)
+            if isinstance(node.ctx, ast.Store) \
+                    and node.id in globals_here:
+                locked = bool(program.locks_held_at(fn, node))
+                decls.setdefault(key, []).append(
+                    (fn, node, locked, groups))
+            elif isinstance(node.ctx, ast.Load):
+                reads.setdefault(key, set()).update(groups)
+    for (key, writes) in decls.items():
+        for (fn, node, locked, groups) in writes:
+            if locked:
+                continue
+            if reads.get(key, set()) - groups:
+                findings.append(Finding(
+                    "CC001", fn.rel, node.lineno,
+                    f"unlocked write to module global '{key[1]}' "
+                    f"read from another thread root — guard it with "
+                    f"the module's lock"))
+
+
+# -- CC002: lock order inversions -------------------------------------
+
+def _acquire_closure(program) -> dict:
+    """qual -> locks a call to this function may acquire (direct
+    with-regions plus callees', to a fixpoint)."""
+    direct = {}
+    for fn in program.functions.values():
+        direct[fn.qual] = {lid for (lid, _r)
+                           in program.with_regions(fn)}
+    closure = {q: set(s) for (q, s) in direct.items()}
+    for _ in range(10):
+        changed = False
+        for fn in program.functions.values():
+            acc = closure[fn.qual]
+            before = len(acc)
+            for (_call, targets) in fn.callees:
+                for t in targets:
+                    acc |= closure.get(t.qual, set())
+            if len(acc) != before:
+                changed = True
+        if not changed:
+            break
+    return closure
+
+
+def _check_cc002(program, findings) -> None:
+    closure = _acquire_closure(program)
+    pairs: dict = {}   # (outer, inner) -> (fn, node)
+    for fn in program.functions.values():
+        regions = program.with_regions(fn)
+        for (lid, region) in regions:
+            held = set(program.entry_locks.get(fn.qual, frozenset()))
+            for (outer_lid, outer) in regions:
+                if outer is region:
+                    continue
+                if outer.lineno <= region.lineno <= getattr(
+                        outer, "end_lineno", outer.lineno):
+                    held.add(outer_lid)
+            for outer_lid in held:
+                if outer_lid != lid:
+                    pairs.setdefault((outer_lid, lid), (fn, region))
+        for (call, targets) in fn.callees:
+            held = program.locks_held_at(fn, call)
+            if not held:
+                continue
+            acquired = set()
+            for t in targets:
+                acquired |= closure.get(t.qual, set())
+            for outer_lid in held:
+                for inner in acquired - held:
+                    pairs.setdefault((outer_lid, inner), (fn, call))
+    for ((a, b), (fn, node)) in pairs.items():
+        if (b, a) in pairs:
+            findings.append(Finding(
+                "CC002", fn.rel, node.lineno,
+                f"lock order inversion: {_lock_name(b)} acquired "
+                f"while holding {_lock_name(a)}, and the reverse "
+                f"order exists elsewhere — pick one global order"))
+
+
+def _lock_name(lid) -> str:
+    return f"{lid[1]}.{lid[2]}"
+
+
+# -- CC003: publishing the guarded object -----------------------------
+
+def _is_copy_wrapped(expr) -> bool:
+    if isinstance(expr, ast.Call):
+        name = dotted(expr.func).rsplit(".", 1)[-1]
+        return name in _COPY_CALLS
+    return False
+
+
+def _mutable_attr_of(program, fn, expr):
+    """(class, attr) when `expr` loads a container-valued instance
+    attribute of a known class."""
+    if not isinstance(expr, ast.Attribute):
+        return None
+    base = expr.value
+    cls = (fn.cls if isinstance(base, ast.Name)
+           and base.id in ("self", "cls")
+           else program.receiver_class(fn, base))
+    if isinstance(cls, ClassNode) and expr.attr in cls.mutable_attrs:
+        return (cls, expr.attr)
+    return None
+
+
+def _check_cc003(program, findings) -> None:
+    for fn in program.functions.values():
+        if fn.is_module:
+            continue
+        regions = program.with_regions(fn)
+        if not regions:
+            continue
+        escaped: dict = {}   # local name -> (attr, bind node)
+        for (_lid, region) in regions:
+            for node in ast.walk(region):
+                if isinstance(node, ast.Return) \
+                        and node.value is not None:
+                    hit = _mutable_attr_of(program, fn, node.value)
+                    if hit is not None:
+                        findings.append(Finding(
+                            "CC003", fn.rel, node.lineno,
+                            f"returns lock-guarded mutable "
+                            f"'{hit[1]}' by reference — the caller "
+                            f"shares the object the lock guards; "
+                            f"return a snapshot copy "
+                            f"(dict()/list()/.copy())"))
+                elif isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name) \
+                        and not _is_copy_wrapped(node.value):
+                    hit = _mutable_attr_of(program, fn, node.value)
+                    if hit is not None:
+                        escaped[node.targets[0].id] = \
+                            (hit[1], node)
+        if not escaped:
+            continue
+        for node in _Scope.iter(fn.node):
+            if not (isinstance(node, ast.Return)
+                    and node.value is not None):
+                continue
+            if _is_copy_wrapped(node.value) and isinstance(
+                    node.value, ast.Call):
+                continue
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Name) and sub.id in escaped:
+                    (attr, bind) = escaped[sub.id]
+                    findings.append(Finding(
+                        "CC003", fn.rel, bind.lineno,
+                        f"lock-guarded mutable '{attr}' bound to "
+                        f"'{sub.id}' under the lock and returned — "
+                        f"the caller shares the guarded object; "
+                        f"bind a snapshot copy instead"))
+                    break
+
+
+# -- CC004: blocking under a lock -------------------------------------
+
+def _is_blocking(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id in _BLOCKING_NAMES
+    if isinstance(f, ast.Attribute):
+        if f.attr not in _BLOCKING_ATTRS:
+            return False
+        # "sep".join(...) is string formatting, not thread join.
+        if f.attr == "join" and isinstance(f.value, ast.Constant):
+            return False
+        return True
+    return False
+
+
+def _check_cc004(program, findings) -> None:
+    for fn in program.functions.values():
+        if fn.is_module:
+            continue
+        entry = program.entry_locks.get(fn.qual, frozenset())
+        regions = program.with_regions(fn)
+        if not regions and not entry:
+            continue
+        for (call, _targets) in fn.callees:
+            if not _is_blocking(call):
+                continue
+            held = program.locks_held_at(fn, call)
+            if held:
+                findings.append(Finding(
+                    "CC004", fn.rel, call.lineno,
+                    f"blocking call "
+                    f"'{dotted(call.func) or 'open'}' while holding "
+                    f"{_lock_name(sorted(held)[0])} — every thread "
+                    f"needing the lock stalls behind the I/O; move "
+                    f"the blocking work outside the lock region"))

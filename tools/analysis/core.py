@@ -29,15 +29,18 @@ _ALLOW_RE = re.compile(
 
 
 class Finding:
-    """One analyzer finding; sorts by location."""
+    """One analyzer finding; sorts by location.  `sup_reason` is
+    filled for suppressed findings (the allow's justification — the
+    SARIF emitter exports it)."""
 
-    __slots__ = ("rule", "rel", "line", "msg")
+    __slots__ = ("rule", "rel", "line", "msg", "sup_reason")
 
     def __init__(self, rule: str, rel: str, line: int, msg: str):
         self.rule = rule
         self.rel = rel
         self.line = line
         self.msg = msg
+        self.sup_reason = None
 
     def key(self):
         return (self.rel, self.line, self.rule)

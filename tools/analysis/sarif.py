@@ -1,0 +1,74 @@
+"""SARIF 2.1.0 emitter for the analyzer (ISSUE 8 satellite).
+
+One run object: the tool driver lists every rule in `_RULE_TABLE`
+(stable index order), unsuppressed findings become `results` at level
+"error", and inline `mastic-allow`ed findings are emitted too —
+marked with an `inSource` suppression carrying the written
+justification — so the SARIF artifact is the complete risk register,
+not just the gate's view.  The structure follows the OASIS SARIF
+2.1.0 schema (the subset GitHub code scanning ingests);
+tests/test_analysis_tool.py validates the invariants.
+"""
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/"
+                "sarif-spec/master/Schemata/sarif-schema-2.1.0.json")
+
+
+def to_sarif(rule_table: dict, findings: list, suppressed: list,
+             reasons: dict = None) -> dict:
+    """The SARIF log dict.  `reasons` maps (rel, line, rule) of a
+    suppressed finding to the allow's justification text."""
+    rule_ids = sorted(rule_table)
+    index = {rid: i for (i, rid) in enumerate(rule_ids)}
+    rules = [{
+        "id": rid,
+        "shortDescription": {"text": rule_table[rid]},
+        "defaultConfiguration": {"level": "error"},
+    } for rid in rule_ids]
+
+    def result(f, sup_reason=None):
+        out = {
+            "ruleId": f.rule,
+            "ruleIndex": index[f.rule],
+            "level": "error",
+            "message": {"text": f.msg},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f.rel,
+                        "uriBaseId": "SRCROOT",
+                    },
+                    "region": {"startLine": max(1, f.line)},
+                },
+            }],
+        }
+        if sup_reason is not None:
+            out["suppressions"] = [{
+                "kind": "inSource",
+                "justification": sup_reason,
+            }]
+        return out
+
+    results = [result(f) for f in findings]
+    for f in suppressed:
+        reason = (reasons or {}).get((f.rel, f.line, f.rule), "")
+        results.append(result(f, sup_reason=reason))
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "mastic-analysis",
+                    "informationUri":
+                        "USAGE.md#static-analysis",
+                    "rules": rules,
+                },
+            },
+            "originalUriBaseIds": {
+                "SRCROOT": {"uri": "file:./"},
+            },
+            "results": results,
+        }],
+    }

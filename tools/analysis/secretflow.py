@@ -1,16 +1,18 @@
-"""Pass 3 — secret-flow / constant-time taint on the scalar layer.
+"""Pass 3 — secret-flow taint: per-file constant-time rules on the
+scalar layer, plus whole-program propagation into the service plane.
 
-Scope: mastic_tpu/vidpf.py, mastic_tpu/mastic.py, mastic_tpu/aes.py,
-mastic_tpu/xof.py — the scalar protocol layer, where the draft's
-timing-hygiene expectations live (the batched backend replaces every
-secret-dependent choice with a lane select by construction; the scalar
-layer is where a branch on a seed-derived bit can actually leak).
+Per-file scope (SF001/SF002): mastic_tpu/vidpf.py, mastic.py, aes.py,
+xof.py — the scalar protocol layer, where the draft's timing-hygiene
+expectations live (the batched backend replaces every
+secret-dependent choice with a lane select by construction; the
+scalar layer is where a branch on a seed-derived bit can actually
+leak).
 
-Taint sources (intraprocedural, per function, to a fixpoint):
+Taint sources (shared by both analyses):
   * parameters whose name marks secret material (seed/key/rand/alpha/
     beta/measurement/input_share and _seed/_key/_rand suffixes);
   * attribute reads of secret node state (.seed, .ctrl, .w,
-    .round_keys);
+    .round_keys — the whole-program rules add .verify_key);
   * calls that produce XOF/PRG output or key material (.next,
     .next_vec, .derive_seed, .encrypt_block, .extend, .convert, .gen,
     .get_beta_share);
@@ -21,19 +23,42 @@ Taint sources (intraprocedural, per function, to a fixpoint):
 `len(x)` and `x is None` escape the taint: lengths and presence are
 public protocol parameters in every construction here.
 
-Rules:
+Per-file rules:
   SF001  Python branch (`if`/`while`/ternary/`assert`) on a tainted
          value — secret-dependent control flow.
   SF002  subscript whose *index* is tainted — secret-dependent memory
          access (the classic table-lookup timing channel).
 
-Known limitation (by design — the analysis is intraprocedural): taint
-does not follow values into callees, so e.g. a variable-time helper
-called *with* secret bytes is the call site's finding, not the
-helper's.  The scalar layer is the differential oracle, not the
-deployment path; real findings here are suppressed with that
-justification rather than rewritten, and the backend twins are the
-constant-time forms.
+Whole-program rules (ISSUE 8) — the taint is propagated across call
+boundaries through the call graph (`callgraph.Program`): a tainted
+argument taints the callee's parameter, a function whose return value
+is tainted taints every resolved call site, iterated to a fixpoint.
+Reported over the service plane (mastic_tpu/drivers/, mastic_tpu/obs/,
+mastic_tpu/metrics.py, tools/serve.py):
+
+  SF003  tainted value reaching a TELEMETRY sink: span attrs/events
+         (`event`, `start_span`, `span`, `.set`), registry series
+         (label kwargs of counter/gauge/histogram, `.inc`/`.observe`
+         values), or `/statusz` rendering — secrets must never be
+         scrapeable, traceable, or Prometheus-labelled.
+
+  SF004  tainted data LEAVING THE PROCESS unencoded outside the
+         blessed `mastic_tpu/wire.py` codecs: socket sends
+         (`send_msg`/`sendall`/`sendto`), file/pipe writes, prints,
+         and subprocess argv/env (argv is world-readable in
+         /proc/<pid>/cmdline).  A value produced by `wire.*` is
+         declassified — the codec layer is the audited egress.
+
+  SF005  tainted value influencing RETRY/BACKOFF TIMING: sleeps,
+         `Deadline(...)` budgets, `settimeout`, or a
+         `timeout=`/`deadline=` keyword computed from secret-derived
+         data — a secret-modulated delay is a remote timing channel.
+
+Known blind spots (documented in USAGE.md): taint does not survive
+storage on instance attributes (other than the named secret attrs),
+dynamic dispatch past the call graph's resolution cap, getattr, or
+callables passed as values.  Real findings are fixed or suppressed
+with a written `# mastic-allow`, same as every pass.
 """
 
 import ast
@@ -41,10 +66,15 @@ import ast
 from .core import Finding, call_name, for_target_taints, target_names
 
 PASS_NAME = "secretflow"
+WHOLE_PROGRAM = True
 
 RULES = {
     "SF001": "branch on secret-derived value",
     "SF002": "secret-dependent subscript index",
+    "SF003": "secret-derived value reaches a telemetry sink",
+    "SF004": "secret-derived value leaves the process outside the "
+             "wire.py codecs",
+    "SF005": "secret-derived value influences retry/backoff timing",
 }
 
 SCOPE_FILES = ("mastic_tpu/vidpf.py", "mastic_tpu/mastic.py",
@@ -79,6 +109,8 @@ def _is_none_test(node: ast.Compare) -> bool:
 
 
 class _TaintAnalysis:
+    SECRET_ATTRS = _SECRET_ATTRS
+
     def __init__(self, fn, info, findings, inherited=()):
         self.fn = fn
         self.info = info
@@ -95,7 +127,7 @@ class _TaintAnalysis:
         if isinstance(node, ast.Name):
             return node.id in self.tainted
         if isinstance(node, ast.Attribute):
-            if node.attr in _SECRET_ATTRS:
+            if node.attr in self.SECRET_ATTRS:
                 return True
             return self.is_tainted(node.value)
         if isinstance(node, ast.Subscript):
@@ -223,6 +255,275 @@ def check(info) -> list:
                 visit(node.body)
 
     visit(info.tree.body)
+    seen = set()
+    out = []
+    for f in findings:
+        if f.key() in seen:
+            continue
+        seen.add(f.key())
+        out.append(f)
+    return out
+
+
+# ====================================================================
+# Whole-program secret flow (SF003-SF005, ISSUE 8)
+# ====================================================================
+
+# Where the whole-program rules REPORT (taint is tracked everywhere).
+WP_SCOPE_PREFIXES = ("mastic_tpu/drivers/", "mastic_tpu/obs/")
+WP_SCOPE_FILES = ("tools/serve.py", "mastic_tpu/metrics.py")
+
+# The service plane adds key-binding material to the secret attrs.
+_WP_SECRET_ATTRS = _SECRET_ATTRS | {"verify_key"}
+
+# Values produced by the audited codec layer are declassified: data
+# may only cross the wire / a file through these.
+_BLESSED_MODULES = ("mastic_tpu.wire", "wire")
+
+_TELEMETRY_CALLS = {"event", "start_span", "start_detached_span",
+                    "span", "render_statusz"}
+_TELEMETRY_METHODS = {"set", "inc", "observe", "set_total"}
+_REGISTRY_CTORS = {"counter", "gauge", "histogram"}
+_EGRESS_METHODS = {"send_msg", "sendall", "sendto", "write"}
+_EGRESS_CALLS = {"print", "Popen", "check_output", "check_call"}
+_TIMING_CALLS = {"sleep", "Deadline", "settimeout"}
+_TIMING_KWARGS = {"timeout", "deadline"}
+
+
+def wp_in_scope(rel: str) -> bool:
+    return rel.startswith(WP_SCOPE_PREFIXES) or rel in WP_SCOPE_FILES
+
+
+class _WPTaint(_TaintAnalysis):
+    """The interprocedural variant: call results resolve through the
+    program's call graph (a resolved callee taints the result only
+    when its RETURN is tainted; unresolved calls keep the per-file
+    pass's conservative arg-taint heuristic), `wire.*` results are
+    declassified, and dicts / f-strings propagate (the service plane
+    marshals secrets through both)."""
+
+    SECRET_ATTRS = _WP_SECRET_ATTRS
+
+    def __init__(self, fnode, engine, extra_params=()):
+        self.fnode = fnode
+        self.engine = engine
+        info = engine.program.infos[fnode.rel]
+        super().__init__(fnode.node, info, [],
+                         inherited=extra_params)
+        self._resolved = {id(call): targets
+                          for (call, targets) in fnode.callees}
+
+    def is_tainted(self, node) -> bool:
+        if isinstance(node, ast.Call):
+            if self._blessed(node):
+                return False
+            targets = self._resolved.get(id(node), ())
+            real = [t for t in targets if not t.is_module]
+            # Weak (multi-candidate dispatch) resolutions keep the
+            # conservative per-file heuristic; a STRONG resolution
+            # uses the callee's actual return taint — a clean callee
+            # does not launder its arguments into a taint.
+            if real and id(node) not in self.fnode.weak_calls:
+                if any(t.qual in self.engine.return_taint
+                       for t in real):
+                    return True
+                if isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in _SECRET_CALLS:
+                    return True
+                return False
+        if isinstance(node, ast.Dict):
+            return any(v is not None and self.is_tainted(v)
+                       for v in list(node.keys) + list(node.values))
+        if isinstance(node, ast.JoinedStr):
+            return any(self.is_tainted(v.value)
+                       for v in node.values
+                       if isinstance(v, ast.FormattedValue))
+        return super().is_tainted(node)
+
+    def _blessed(self, call: ast.Call) -> bool:
+        name = call_name(call)
+        if name.rsplit(".", 1)[0] in _BLESSED_MODULES:
+            return True
+        targets = self._resolved.get(id(call), ())
+        return any(t.module == "mastic_tpu.wire" for t in targets)
+
+
+class _InterTaint:
+    """Fixpoint over the call graph: per-function tainted-parameter
+    sets and the tainted-return set."""
+
+    MAX_ROUNDS = 20
+
+    def __init__(self, program):
+        self.program = program
+        self.param_taint: dict = {}     # qual -> set of param names
+        self.return_taint: set = set()  # quals returning taint
+        for fn in program.functions.values():
+            if fn.is_module:
+                continue
+            self.param_taint[fn.qual] = {
+                p for p in fn.params() if _secret_param(p)}
+        self._fixpoint()
+
+    def _analysis(self, fn) -> _WPTaint:
+        ta = _WPTaint(fn, self,
+                      extra_params=self.param_taint.get(fn.qual, ()))
+        ta.propagate()
+        return ta
+
+    def _fixpoint(self) -> None:
+        """Worklist fixpoint: a function re-analyzes only when its
+        tainted-parameter set grew or a callee's return newly turned
+        tainted — the classic dataflow scheduling, so the whole-tree
+        run costs ~one analysis per function instead of one per
+        function per round."""
+        from .tracesafe import iter_scope
+
+        fns = {f.qual: f for f in self.program.functions.values()
+               if not f.is_module}
+        work = list(fns.values())
+        queued = set(fns)
+        guard = self.MAX_ROUNDS * max(1, len(fns))
+        while work and guard > 0:
+            guard -= 1
+            fn = work.pop()
+            queued.discard(fn.qual)
+
+            def enqueue(qual):
+                if qual in fns and qual not in queued:
+                    queued.add(qual)
+                    work.append(fns[qual])
+
+            ta = self._analysis(fn)
+            if fn.qual not in self.return_taint:
+                for node in iter_scope(fn.node):
+                    if isinstance(node, ast.Return) \
+                            and node.value is not None \
+                            and ta.is_tainted(node.value):
+                        self.return_taint.add(fn.qual)
+                        for (caller, _call) in fn.callers:
+                            if not caller.is_module:
+                                enqueue(caller.qual)
+                        break
+            for (call, targets) in fn.callees:
+                if id(call) in fn.weak_calls:
+                    continue   # multi-candidate dispatch: do not
+                    #            spread taint to every candidate
+                for t in targets:
+                    if t.is_module:
+                        continue
+                    if self._spread_args(ta, call, t):
+                        enqueue(t.qual)
+
+    def _spread_args(self, ta, call, callee) -> bool:
+        params = callee.params()
+        if params and params[0] in ("self", "cls"):
+            params = params[1:]
+        sink = self.param_taint.setdefault(callee.qual, set())
+        before = len(sink)
+        for (i, arg) in enumerate(call.args):
+            if isinstance(arg, ast.Starred):
+                continue
+            if i < len(params) and ta.is_tainted(arg):
+                sink.add(params[i])
+        for kw in call.keywords:
+            if kw.arg is not None and kw.arg in params \
+                    and ta.is_tainted(kw.value):
+                sink.add(kw.arg)
+        return len(sink) != before
+
+
+def _call_tail(call: ast.Call) -> str:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+def _wp_sinks(ta: _WPTaint, fn, findings) -> None:
+    for (call, _targets) in fn.callees:
+        tail = _call_tail(call)
+        dotted_name = call_name(call)
+        args = [a for a in call.args
+                if not isinstance(a, ast.Starred)]
+        kwargs = [kw for kw in call.keywords if kw.arg is not None]
+        tainted_args = [a for a in args if ta.is_tainted(a)]
+        tainted_kwargs = [kw for kw in kwargs
+                          if ta.is_tainted(kw.value)]
+
+        # SF005 first: a timing kwarg on ANY call.
+        timing_kw = [kw for kw in tainted_kwargs
+                     if kw.arg in _TIMING_KWARGS]
+        if timing_kw:
+            findings.append(Finding(
+                "SF005", fn.rel, call.lineno,
+                f"secret-derived value sets '{timing_kw[0].arg}=' on "
+                f"'{dotted_name[:40]}' — a secret-modulated delay is "
+                f"a remote timing channel"))
+        if tail in _TIMING_CALLS and (tainted_args
+                                      or tainted_kwargs):
+            findings.append(Finding(
+                "SF005", fn.rel, call.lineno,
+                f"secret-derived value reaches timing primitive "
+                f"'{dotted_name[:40]}' — retry/backoff schedules "
+                f"must not depend on secrets"))
+            continue
+
+        if tail in _TELEMETRY_CALLS and (tainted_args
+                                         or tainted_kwargs):
+            findings.append(Finding(
+                "SF003", fn.rel, call.lineno,
+                f"secret-derived value recorded by telemetry call "
+                f"'{dotted_name[:40]}' — spans/events are scraped, "
+                f"ring-buffered and written to trace JSONL"))
+            continue
+        if tail in _REGISTRY_CTORS and isinstance(
+                call.func, ast.Attribute) and tainted_kwargs:
+            findings.append(Finding(
+                "SF003", fn.rel, call.lineno,
+                f"secret-derived value used as a registry label on "
+                f"'{dotted_name[:40]}' — labels are exported "
+                f"verbatim at /metrics"))
+            continue
+        if tail in _TELEMETRY_METHODS and isinstance(
+                call.func, ast.Attribute) and (tainted_args
+                                               or tainted_kwargs):
+            findings.append(Finding(
+                "SF003", fn.rel, call.lineno,
+                f"secret-derived value recorded via "
+                f"'.{tail}()' — registry/span state is exported at "
+                f"/metrics and /statusz"))
+            continue
+
+        egress = (tail in _EGRESS_METHODS and isinstance(
+            call.func, ast.Attribute)) \
+            or tail in _EGRESS_CALLS \
+            or dotted_name in ("os.write", "subprocess.run")
+        if egress:
+            leak = tainted_args or [
+                kw for kw in tainted_kwargs if kw.arg == "env"]
+            if leak:
+                findings.append(Finding(
+                    "SF004", fn.rel, call.lineno,
+                    f"secret-derived value leaves the process via "
+                    f"'{dotted_name[:40]}' without passing the "
+                    f"wire.py codecs (argv/env are world-readable "
+                    f"in /proc; files and sockets need the audited "
+                    f"encoders)"))
+
+
+def check_program(program, force_scope: bool = False) -> list:
+    engine = _InterTaint(program)
+    findings: list = []
+    for fn in program.functions.values():
+        if fn.is_module:
+            continue
+        if not force_scope and not wp_in_scope(fn.rel):
+            continue
+        ta = engine._analysis(fn)
+        _wp_sinks(ta, fn, findings)
     seen = set()
     out = []
     for f in findings:

@@ -35,7 +35,12 @@ Checks, over mastic_tpu/, tests/, tools/ and the repo-root scripts:
    (mastic_tpu/obs/registry.py DECLARED) appears in USAGE.md's
    "Observability" metric table — an operator reading /metrics must
    be able to look every series up, so a new metric cannot ship
-   undocumented (the metric twin of check 7's lever rule).
+   undocumented (the metric twin of check 7's lever rule);
+10. USAGE.md's "Static analysis" rule table lists EXACTLY the rule
+   IDs in tools.analysis._RULE_TABLE — both directions: a shipped
+   rule missing from the table is undocumented, a table row whose
+   rule no longer exists is stale (the analyzer twin of check 9;
+   the table had only stayed in sync by luck before).
 
 Exit status 0 iff clean.  Run via `make lint` / `make ci`.
 """
@@ -410,6 +415,40 @@ def check_metric_docs() -> list:
     return problems
 
 
+_RULE_ROW_RE = re.compile(r"^\|\s*`([A-Z]{2}\d{3})`")
+
+
+def check_rule_table_docs() -> list:
+    """Check 10: the USAGE.md analyzer rule table == the analyzer's
+    _RULE_TABLE.  The table rows are the lines starting `| \\`XX000\\``
+    inside the "Static analysis" section (same import-the-source-of-
+    truth pattern as check 9 — tools.analysis is stdlib-only)."""
+    sys.path.insert(0, str(REPO))
+    from tools.analysis import _RULE_TABLE
+
+    usage = (REPO / "USAGE.md").read_text()
+    in_section = False
+    documented = set()
+    for line in usage.splitlines():
+        if line.startswith("## "):
+            in_section = line.startswith("## Static analysis")
+            continue
+        if in_section:
+            m = _RULE_ROW_RE.match(line)
+            if m:
+                documented.add(m.group(1))
+    problems = []
+    for rule in sorted(set(_RULE_TABLE) - documented):
+        problems.append(
+            f"tools/analysis: rule {rule} is shipped but missing "
+            f"from USAGE.md's Static-analysis rule table")
+    for rule in sorted(documented - set(_RULE_TABLE)):
+        problems.append(
+            f"USAGE.md: rule-table row {rule} names a rule the "
+            f"analyzer no longer ships — remove the stale row")
+    return problems
+
+
 def check_mypy_sync() -> list:
     """Check 8: ANNOTATED == mypy.ini's strict module set, so the
     runtime annotation gate (checks 3/5) covers exactly the modules
@@ -445,6 +484,7 @@ def main() -> int:
     problems += check_env_levers()
     problems += check_mypy_sync()
     problems += check_metric_docs()
+    problems += check_rule_table_docs()
     for problem in problems:
         print(problem)
     print(f"lint: {len(files)} files, {len(problems)} problem(s)")

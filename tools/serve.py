@@ -180,6 +180,11 @@ def check_status_endpoints(status) -> None:
 def write_snapshot(svc, path: str) -> None:
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
+        # mastic-allow: SF004 — the snapshot is the durable
+        # crash-resume medium and MUST carry the tenant key bindings
+        # (the resumed process re-derives nothing); the trust
+        # boundary is filesystem permissions on the operator's
+        # --snapshot path, not the codec layer
         f.write(svc.to_bytes())
     os.replace(tmp, path)
 
