@@ -37,6 +37,15 @@
 #                (drivers/pipeline.py: serial bit-identity, overlap
 #                timeline, AOT bucket compile, budget fallback) —
 #                fast tier only
+#   make artifacts-smoke  AOT artifact-store gate
+#                (drivers/artifacts.py, ISSUE 9): fast tier of
+#                tests/test_artifacts.py (digest/runtime/probe
+#                gates, cache tier, runtime-skew refusal) plus
+#                tools/bake.py --smoke — bake a tiny config, then a
+#                FRESH subprocess completes the whole collection
+#                with zero inline compiles and bit-identical
+#                hitters + per-round counters vs the inline-traced
+#                path
 #   make multichip  mesh-sharded round suite (fast tier of
 #                tests/test_mesh_pipeline.py: envelope/padding/key
 #                units + per-device allocation parity) plus the REAL
@@ -50,11 +59,11 @@
 PY ?= python
 
 .PHONY: ci lint analyze faults serve-smoke obs-smoke pipeline \
-	multichip typecheck test-fast test test-slow test-slow-1 \
-	test-slow-2 test-slow-3 bench
+	artifacts-smoke multichip typecheck test-fast test test-slow \
+	test-slow-1 test-slow-2 test-slow-3 bench
 
-ci: lint analyze faults serve-smoke obs-smoke pipeline multichip \
-	typecheck test-fast
+ci: lint analyze faults serve-smoke obs-smoke pipeline \
+	artifacts-smoke multichip typecheck test-fast
 
 faults:
 	$(PY) -m pytest tests/test_faults.py -q -m "not slow"
@@ -78,6 +87,10 @@ obs-smoke:
 pipeline:
 	$(PY) -m pytest tests/test_pipeline.py -q -m "not slow"
 
+artifacts-smoke:
+	$(PY) -m pytest tests/test_artifacts.py -q -m "not slow"
+	JAX_PLATFORMS=cpu $(PY) tools/bake.py --smoke
+
 multichip:
 	$(PY) -m pytest tests/test_mesh_pipeline.py -q -m "not slow"
 	JAX_PLATFORMS=cpu $(PY) tools/multichip.py
@@ -98,15 +111,16 @@ typecheck:
 	fi
 
 # test_faults' / test_service's / test_obs' / test_pipeline's /
-# test_mesh_pipeline's fast tiers already ran as their own gates
-# right after analyze — skip them here so `make ci` doesn't pay for
-# them twice.
+# test_artifacts' / test_mesh_pipeline's fast tiers already ran as
+# their own gates right after analyze — skip them here so `make ci`
+# doesn't pay for them twice.
 test-fast:
 	$(PY) -m pytest tests/ -q -m "not slow" \
 		--ignore=tests/test_faults.py \
 		--ignore=tests/test_service.py \
 		--ignore=tests/test_obs.py \
 		--ignore=tests/test_pipeline.py \
+		--ignore=tests/test_artifacts.py \
 		--ignore=tests/test_mesh_pipeline.py
 
 test-slow:

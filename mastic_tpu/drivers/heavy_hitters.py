@@ -645,13 +645,23 @@ class RoundPrograms:
     """
 
     def _init_programs(self) -> None:
+        from . import artifacts
         from .pipeline import ProgramCache
 
         self._eval_fn = None
         self._combine_fn = None
         self._wc_fns: dict = {}
-        self.programs = ProgramCache()
+        self._rk_fn_jit = None
+        # Runtime + family suffix on every program key: a program
+        # compiled under a different jax build/backend, or for a
+        # different instantiation/ctx, can never be served — in
+        # process (ProgramCache refuses skewed runtimes) or from the
+        # AOT artifact store (drivers/artifacts.py, ROADMAP item 4).
+        self._key_suffix = (artifacts.runtime_tag(),
+                            artifacts.family_id(self.bm, self.ctx))
+        self.programs = ProgramCache(store=artifacts.store_from_env())
         self._warmed_keys: set = set()
+        self._stats_mark = dict(self.programs.stats)
 
     # -- mesh plumbing (report-axis data parallelism) --------------
 
@@ -672,6 +682,16 @@ class RoundPrograms:
 
         return NamedSharding(self.mesh, P())
 
+    # Whether the eval program donates its carry args.  True for the
+    # runners (in-process compiles: donation halves the transient
+    # carry footprint).  artifacts.make_baker sets False: an
+    # executable with input-output aliasing DOUBLE-FREES its donated
+    # buffers when deserialized on this jaxlib CPU (heap corruption,
+    # allocator-state dependent, invisible to the output probe —
+    # PERF.md §11), so baked programs must be donation-free and
+    # ArtifactStore.save refuses donating executables outright.
+    _donate_carries = True
+
     def _eval_jit(self):
         if self._eval_fn is None:
             engine = self.engine
@@ -685,14 +705,17 @@ class RoundPrograms:
                 accept = jnp.all(proof0 == proof1, axis=-1)
                 return (c0, c1, out0, out1, accept, ok0 & ok1)
 
-            # Carries are donated: both runners replace them with the
-            # outputs (resident keeps them resident; chunked re-uploads
-            # fresh buffers every chunk).  The verify key is traced so
-            # a fresh per-collection key reuses the compiled program.
+            # Carries are donated (unless _donate_carries is off, the
+            # bake path): both runners replace them with the outputs
+            # (resident keeps them resident; chunked re-uploads fresh
+            # buffers every chunk).  The verify key is traced so a
+            # fresh per-collection key reuses the compiled program.
             # Under a mesh every output is pinned report-sharded so the
             # eval -> combine handoff has deterministic shardings (the
             # AOT warm lowers against exactly these).
-            kwargs: dict = {"donate_argnums": (1, 2)}
+            kwargs: dict = {}
+            if self._donate_carries:
+                kwargs["donate_argnums"] = (1, 2)
             if self.mesh is not None:
                 rep = self._rep_sharding()
                 kwargs["out_shardings"] = (rep,) * 6
@@ -739,10 +762,65 @@ class RoundPrograms:
         from .pipeline import plan_shape_key
 
         return ("eval", rows, self._mesh_shards()) \
-            + plan_shape_key(plan)
+            + plan_shape_key(plan) + self._key_suffix
 
     def _agg_key(self, rows: int, out_cols: int) -> tuple:
-        return ("agg", rows, self._mesh_shards(), out_cols)
+        return ("agg", rows, self._mesh_shards(), out_cols) \
+            + self._key_suffix
+
+    def _wc_key(self, rows: int, level: int) -> tuple:
+        return ("wc", rows, self._mesh_shards(), level) \
+            + self._key_suffix
+
+    def _rk_key(self, rows: int) -> tuple:
+        # The AES key-schedule program runs before mesh placement on
+        # every path, so the mesh shape is not part of its key.
+        return ("rk", rows) + self._key_suffix
+
+    def _preload_first_round(self, rows: int, rk_rows: int) -> int:
+        """Pull the FIRST round's program set (level-0 eval/agg/wc +
+        the key schedule) from the artifact store at construction —
+        exactly the keys on the time-to-first-round critical path.
+        Deeper levels prefetch in the predictor's overlapped warm
+        slot instead (`ProgramCache.warm` consults the store before
+        compiling), so their ~1.5 s-per-program load latency hides
+        behind device execution rather than stacking up in front of
+        round 0 (measured: whole-family preload put 10 sequential
+        loads on the critical path and more than doubled the warm
+        cold start)."""
+        if self.programs.store is None:
+            return 0
+        from ..backend.incremental import RoundPlan
+
+        plan0 = RoundPlan(((False,), (True,)), 0,
+                          self.bm.m.vidpf.BITS, self.width, [])
+        out_cols = len(plan0.out_idx) * (1 + self.bm.m.flp.OUTPUT_LEN)
+        wanted = {self._eval_key(rows, plan0),
+                  self._agg_key(rows, out_cols),
+                  self._wc_key(rows, 0),
+                  self._rk_key(rk_rows)}
+        return self.programs.preload(lambda key: key in wanted)
+
+    def _artifacts_block(self) -> dict:
+        """The per-round `extra["artifacts"]` record (obs/schema.py):
+        artifact hits vs inline compiles since the previous round —
+        the stamp that makes "this round never traced" a measured
+        claim in every metrics record."""
+        s = self.programs.stats
+        m = self._stats_mark
+        block = {
+            "store": (self.programs.store.path
+                      if self.programs.store is not None else None),
+            "hits": s["artifact_hits"] - m["artifact_hits"],
+            "inline_compiles": (s["inline_compiles"]
+                                - m["inline_compiles"]),
+            "warm_compiles": (s["warm_compiles"]
+                              - m["warm_compiles"]),
+            "load_ms": round(s["artifact_load_ms"]
+                             - m["artifact_load_ms"], 2),
+        }
+        self._stats_mark = dict(s)
+        return block
 
     def _eval_program(self, rows: int, plan, args) -> tuple:
         """(program, compile_wait_seconds) for this round's eval:
@@ -759,6 +837,124 @@ class RoundPrograms:
         return self.programs.get(
             self._agg_key(rows, cargs[0].shape[1]),
             lambda: self._combine_jit().lower(*cargs))
+
+    def _wc_program(self, rows: int, level: int, wcargs) -> tuple:
+        """The weight-check (FLP) program through the same AOT cache
+        tier as eval/agg: pre-r14 it was a bare per-level jit, so a
+        cold process's FIRST round (level 0 runs the weight check)
+        paid its full compile outside the artifact machinery."""
+        return self.programs.get(
+            self._wc_key(rows, level),
+            lambda: self._wc_fn(level).lower(*wcargs))
+
+    def _rk_jit(self):
+        if self._rk_fn_jit is None:
+            (bm, ctx) = (self.bm, self.ctx)
+            self._rk_fn_jit = jax.jit(
+                lambda n: bm.vidpf.roundkeys(ctx, n))
+        return self._rk_fn_jit
+
+    def _rk_program(self, rows: int, args) -> tuple:
+        """The AES round-key schedule, AOT-cached: both runners pay
+        it once at construction — the last compile standing between a
+        warm artifact store and a trace-free cold start."""
+        return self.programs.get(
+            self._rk_key(rows),
+            lambda: self._rk_jit().lower(*args))
+
+    # -- abstract lowering signatures (bake + warm share these) ----
+
+    def _sds(self, shape, dtype, sharding=None):
+        if sharding is None:
+            return jax.ShapeDtypeStruct(shape, dtype)
+        return jax.ShapeDtypeStruct(shape, dtype, sharding=sharding)
+
+    def _mesh_sh(self) -> tuple:
+        return ((self._rep_sharding(), self._repl_sharding())
+                if self.mesh is not None else (None, None))
+
+    def _eval_structs(self, rows: int, plan) -> tuple:
+        """The eval program's full abstract signature at `rows` —
+        what `tools/bake.py` lowers against when no reports exist.
+        Shapes mirror the runners' concrete args exactly (per-report
+        tensors report-sharded under a mesh, small round inputs
+        replicated); drift between this and a real call surfaces as
+        a cache miss, never a wrong program."""
+        from ..backend.incremental import Carry, round_inputs
+        from ..backend.vidpf_jax import BatchedCorrectionWords
+
+        (rep, repl) = self._mesh_sh()
+        vid = self.bm.vidpf
+        (bits, vl) = (vid.BITS, vid.VALUE_LEN)
+        n = self.bm.spec.num_limbs
+        w = plan.width
+        carry = Carry(
+            w=self._sds((rows, bits, w, vl, n), jnp.uint32, rep),
+            proof=self._sds((rows, bits, w, 32), jnp.uint8, rep),
+            seed=self._sds((rows, w, 16), jnp.uint8, rep),
+            ctrl=self._sds((rows, w), jnp.bool_, rep))
+        rnd = jax.tree_util.tree_map(
+            lambda x: self._sds(x.shape, x.dtype, repl),
+            round_inputs(plan))
+        (erk, crk) = jax.eval_shape(
+            lambda nn: self.bm.vidpf.roundkeys(self.ctx, nn),
+            jax.ShapeDtypeStruct((rows, 16), jnp.uint8))
+        cws = BatchedCorrectionWords(
+            seed=self._sds((rows, bits, 16), jnp.uint8, rep),
+            ctrl=self._sds((rows, bits, 2), jnp.bool_, rep),
+            w=self._sds((rows, bits, vl, n), jnp.uint32, rep),
+            proof=self._sds((rows, bits, 32), jnp.uint8, rep))
+        vk = self._sds((self.bm.m.VERIFY_KEY_SIZE,), jnp.uint8, repl)
+        return (vk, carry, carry, rnd,
+                self._sds(erk.shape, erk.dtype, rep),
+                self._sds(crk.shape, crk.dtype, rep), cws)
+
+    def _agg_structs(self, rows: int, out_cols: int) -> tuple:
+        (rep, _repl) = self._mesh_sh()
+        n = self.bm.spec.num_limbs
+        s_out = self._sds((rows, out_cols, n), jnp.uint32, rep)
+        s_mask = self._sds((rows,), jnp.bool_, rep)
+        return (s_out, s_out) + (s_mask,) * 6
+
+    def _batch_structs(self, rows: int):
+        from ..backend.mastic_jax import ReportBatch
+        from ..backend.vidpf_jax import BatchedCorrectionWords
+
+        (rep, _repl) = self._mesh_sh()
+        m = self.bm.m
+        vid = self.bm.vidpf
+        (bits, vl) = (vid.BITS, vid.VALUE_LEN)
+        n = self.bm.spec.num_limbs
+        use_jr = m.flp.JOINT_RAND_LEN > 0
+
+        def u8(*shape):
+            return self._sds(shape, jnp.uint8, rep)
+
+        return ReportBatch(
+            nonces=u8(rows, 16),
+            cws=BatchedCorrectionWords(
+                seed=u8(rows, bits, 16),
+                ctrl=self._sds((rows, bits, 2), jnp.bool_, rep),
+                w=self._sds((rows, bits, vl, n), jnp.uint32, rep),
+                proof=u8(rows, bits, 32)),
+            keys=u8(rows, 2, 16),
+            leader_proofs=self._sds((rows, m.flp.PROOF_LEN, n),
+                                    jnp.uint32, rep),
+            helper_seeds=u8(rows, 32),
+            leader_seeds=u8(rows, 32) if use_jr else None,
+            peer_parts=tuple(u8(rows, 32) if use_jr else None
+                             for _ in range(2)))
+
+    def _wc_structs(self, rows: int) -> tuple:
+        (rep, repl) = self._mesh_sh()
+        vid = self.bm.vidpf
+        n = self.bm.spec.num_limbs
+        vk = self._sds((self.bm.m.VERIFY_KEY_SIZE,), jnp.uint8, repl)
+        w = self._sds((rows, 2, vid.VALUE_LEN, n), jnp.uint32, rep)
+        return (vk, self._batch_structs(rows), w, w)
+
+    def _rk_structs(self, rows: int) -> tuple:
+        return (self._sds((rows, 16), jnp.uint8),)
 
     def _warm_next(self, plan, args, rows: int) -> float:
         """Ahead-of-time compile the predicted next level's (bucket,
@@ -822,8 +1018,10 @@ class RoundPrograms:
         eval key had been predicted+warmed, what the cache has done so
         far, and the compile wait this round actually paid."""
         key = self._eval_key(rows, plan)
+        # Display form drops the runtime/family suffix (constant per
+        # process; the full key is what the cache and store use).
         return {
-            "eval_key": "x".join(str(k) for k in key[1:]),
+            "eval_key": "x".join(str(k) for k in key[1:-2]),
             "predicted": key in self._warmed_keys,
             "compile_wait_ms": round(compile_wait_ms, 2),
             **self.programs.stats,
@@ -883,15 +1081,21 @@ class _IncrementalRunner(RoundPrograms):
         self.width = max(4, width)
         self.mesh = None  # set via parallel.mesh.shard_incremental_runner
         self.engine = IncrementalMastic(bm, self.width)
-        (self.ext_rk, self.conv_rk) = jax.jit(
-            lambda n: bm.vidpf.roundkeys(ctx, n))(batch.nonces)
+        self.layouts: list = []  # per-depth creation layouts
+        self._init_programs()
+        # Warm artifact store: the first round's programs land in
+        # the in-process tier here, so even the key-schedule below
+        # and round 0 never trace (drivers/artifacts.py); deeper
+        # levels prefetch in the overlapped warm slot.
+        self._preload_first_round(self.num_reports, self.num_reports)
+        (rk_prog, _rk_wait) = self._rk_program(self.num_reports,
+                                               (batch.nonces,))
+        (self.ext_rk, self.conv_rk) = rk_prog(batch.nonces)
         self.carries = [
             self.engine.init_carry(self.num_reports,
                                    batch.keys[:, a], a)
             for a in range(2)
         ]
-        self.layouts: list = []  # per-depth creation layouts
-        self._init_programs()
 
     def memory_accounting(self) -> dict:
         """Device-resident footprint: both carries, the round keys and
@@ -990,15 +1194,18 @@ class _IncrementalRunner(RoundPrograms):
             t_disp0 = time.perf_counter()
             (c0, c1, out0, out1, accept_ev, ok) = eval_prog(*args)
             wc_checks = {}
+            wc_compile_s = 0.0
             (wc_accept, wc_okdev, jr) = (ones, ones, ones)
             if do_weight_check:
                 # FLP weight check on the depth-0 payload rows the
                 # tree program just computed (rows 0..1 of depth 0 are
                 # always the two root children) — a small FLP-only
                 # program, not a second from-root tree eval.
-                (wc_checks, wc_okdev) = self._wc_fn(level)(
-                    vk_arr, self.batch, c0.w[:, 0, :2],
-                    c1.w[:, 0, :2])
+                wcargs = (vk_arr, self.batch, c0.w[:, 0, :2],
+                          c1.w[:, 0, :2])
+                (wc_prog, wc_compile_s) = self._wc_program(
+                    self.num_reports, level, wcargs)
+                (wc_checks, wc_okdev) = wc_prog(*wcargs)
                 wc_accept = wc_checks["weight_check"]
                 jr = wc_checks.get("joint_rand", ones)
             cargs = (out0, out1, accept_ev, ok, valid,
@@ -1066,7 +1273,8 @@ class _IncrementalRunner(RoundPrograms):
         metrics.xof_fallbacks = int(self.fallback.sum())
         metrics.rejected_fallback = int((self.fallback & ~accept).sum())
         t_host = time.perf_counter()
-        compile_ms = (compile_s + agg_compile_s) * 1e3
+        compile_ms = (compile_s + agg_compile_s + wc_compile_s) * 1e3
+        metrics.extra["artifacts"] = self._artifacts_block()
         if self.mesh is not None:
             metrics.extra["mesh"] = {
                 "report_shards": self.mesh.shape["reports"],
@@ -1087,7 +1295,8 @@ class _IncrementalRunner(RoundPrograms):
                 "upload_ms": round((t_up - t0) * 1e3, 3),
                 "compile_ms": round(compile_ms, 3),
                 "dispatch_ms": round(
-                    (t_disp1 - t_disp0 - agg_compile_s) * 1e3, 3),
+                    (t_disp1 - t_disp0 - agg_compile_s
+                     - wc_compile_s) * 1e3, 3),
                 "warm_ms": round(warm_s * 1e3, 3),
                 "compute_wait_ms": round((t_wait - t_warm) * 1e3, 3),
                 "download_ms": round((t_down - t_wait) * 1e3, 3),

@@ -199,9 +199,13 @@ def to_struct(x) -> jax.ShapeDtypeStruct:
 class ProgramCache:
     """Compiled round programs keyed by the shapes they actually
     close over (chunk rows, padded width, the pow2 binder/out
-    buckets) — NOT cleared on width growth: a grown runner simply
-    compiles (or has pre-warmed) the new width's keys while the old
-    entries become unreachable.
+    buckets) plus the runtime tag (jax version + backend) and program
+    family (instantiation + ctx digest) — NOT cleared on width
+    growth: a grown runner simply compiles (or has pre-warmed) the
+    new width's keys while the old entries become unreachable.  A key
+    stamped for a different runtime is REFUSED (`artifacts.
+    check_key_runtime`): an in-process cache can never serve a
+    program compiled under a different jax build or backend.
 
     `get` is the inline path: returns the compiled program plus the
     seconds the caller had to WAIT for it — zero exactly when a warm
@@ -221,19 +225,57 @@ class ProgramCache:
     so the device computes through the compile — with none of the
     failure modes, and it composes with the persistent
     `jax_compilation_cache_dir` across processes.
+
+    `store` plugs in the AOT artifact tier (`drivers/artifacts.py`,
+    ROADMAP item 4): below the in-process dict, a cache miss consults
+    the digest-sealed, probe-verified on-disk store before paying
+    XLA — `get` loads inline (the wait is the disk+probe latency,
+    ~1.5 s vs ~21 s compile on this fabric), `warm` prefetches from
+    disk in the same overlapped slot it would have compiled in, and
+    `preload` walks the store up front so first rounds hit the
+    in-process tier directly.  Artifact loads are never counted as
+    inline compiles — the `artifact_hits` / `artifact_load_ms` stats
+    attribute them separately.
     """
 
-    def __init__(self):
+    def __init__(self, store=None):
         self._programs: dict = {}
+        self.store = store
         self.stats = {"inline_compiles": 0, "warm_compiles": 0,
-                      "warm_errors": 0}
+                      "warm_errors": 0, "artifact_hits": 0,
+                      "artifact_load_ms": 0.0}
+
+    def _check_runtime(self, key) -> None:
+        from .artifacts import check_key_runtime
+
+        check_key_runtime(key)
+
+    def _from_store(self, key):
+        """Artifact-tier lookup: gated load (digest / runtime / probe
+        — see artifacts.ArtifactStore.load), memoized into the
+        in-process tier on success."""
+        if self.store is None:
+            return None
+        t0 = time.perf_counter()
+        prog = self.store.load(key)
+        if prog is None:
+            return None
+        self._programs[key] = prog
+        self.stats["artifact_hits"] += 1
+        self.stats["artifact_load_ms"] += \
+            (time.perf_counter() - t0) * 1e3
+        return prog
 
     def get(self, key, build: Callable) -> tuple:
         """(compiled, wait_seconds); `build()` returns a Lowered."""
+        self._check_runtime(key)
         prog = self._programs.get(key)
         if prog is not None:
             return (prog, 0.0)
         t0 = time.perf_counter()
+        prog = self._from_store(key)
+        if prog is not None:
+            return (prog, time.perf_counter() - t0)
         with paused_gc():
             compiled = build().compile()
         self._programs[key] = compiled
@@ -241,13 +283,18 @@ class ProgramCache:
         return (compiled, time.perf_counter() - t0)
 
     def warm(self, key, build: Callable) -> float:
-        """Compile `key` now if absent; returns the seconds spent.
+        """Land `key` now if absent — from the artifact store when it
+        has the key (the predictor prefetches from disk before
+        compiling), else by compiling; returns the seconds spent.
         Errors are counted, never raised: a mispredicted or
         unbuildable warm must not take down the round that scheduled
         it — the real round compiles inline instead."""
+        self._check_runtime(key)
         if key in self._programs:
             return 0.0
         t0 = time.perf_counter()
+        if self._from_store(key) is not None:
+            return time.perf_counter() - t0
         try:
             with paused_gc():
                 self._programs[key] = build().compile()
@@ -255,6 +302,25 @@ class ProgramCache:
         except Exception:
             self.stats["warm_errors"] += 1
         return time.perf_counter() - t0
+
+    def preload(self, match: Callable) -> int:
+        """Pull every store entry whose key passes `match` into the
+        in-process tier (runner construction calls this with its
+        shape family, so the first round's `get` is a pure dict
+        hit and the timeline's compile field stays zero)."""
+        if self.store is None:
+            return 0
+        n = 0
+        for key in self.store.keys():
+            if key in self._programs or not match(key):
+                continue
+            if self._from_store(key) is not None:
+                n += 1
+        return n
+
+    def entries(self) -> dict:
+        """The compiled programs by key (bake-from-run export)."""
+        return dict(self._programs)
 
     def contains(self, key) -> bool:
         return key in self._programs

@@ -463,6 +463,41 @@ class CollectorService:
             self.tenants[spec.name] = _Tenant(spec)
         self._rr = 0   # round-robin cursor over tenant order
         self.resumed = False
+        # Warm AOT artifact store (drivers/artifacts.py): preload
+        # every tenant's program family at boot so the first epoch of
+        # each never traces — the ROADMAP item 4 enabler for epoch
+        # overlap and containerized serving.
+        for t in self.tenants.values():
+            self._preload_artifacts(t)
+
+    def add_tenant(self, spec: TenantSpec) -> None:
+        """Admit a new collection tenant into the running service
+        (fresh buffers/counters; uploads may `submit()` immediately).
+        Its artifact family preloads right here, so with a baked
+        store the new tenant's first round pays disk loads at
+        admission time, not a trace at epoch time."""
+        if spec.name in self.tenants:
+            raise ValueError(f"duplicate tenant {spec.name!r}")
+        t = _Tenant(spec)
+        self.tenants[spec.name] = t
+        self._preload_artifacts(t)
+
+    def _preload_artifacts(self, t: _Tenant) -> None:
+        """Pull the tenant's program family (instantiation + ctx)
+        from the AOT store into memory — digest-gated and probe-
+        verified per artifact (artifacts.ArtifactStore.load); every
+        outcome lands in mastic_artifact_loads_total."""
+        from ..backend.mastic_jax import BatchedMastic
+        from . import artifacts
+
+        store = artifacts.store_from_env()
+        if store is None:
+            return
+        fam = artifacts.family_id(BatchedMastic(t.mastic), t.spec.ctx)
+        counts = store.preload(lambda key: key[-1] == fam)
+        if counts:
+            obs_trace.event("artifact_preload", tenant=t.spec.name,
+                            store=store.path, **counts)
 
     # -- small config helpers --------------------------------------
 

@@ -102,6 +102,10 @@ DECLARED = {
     "mastic_obs_label_overflow_total":
         ("counter", "label sets collapsed by the cardinality cap",
          ("metric",)),
+    "mastic_artifact_loads_total":
+        ("counter", "AOT artifact-store load attempts, by gate "
+         "outcome (hit/miss/probe_fail/version_skew/corrupt)",
+         ("outcome",)),
 }
 
 

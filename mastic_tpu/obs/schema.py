@@ -45,6 +45,10 @@ Block shapes (all times float milliseconds):
                      ...}
   extra["service"]  {tenant, epoch, sched_overhead_ms,
                      buffered_reports, pending_epochs}
+  extra["artifacts"] {store, hits, inline_compiles, ...}
+                    the AOT artifact-store stamp (r14): store path
+                    (None = no store armed), per-round artifact
+                    hits vs inline compiles
 """
 
 from typing import Optional
@@ -71,6 +75,13 @@ MESH_REQUIRED = frozenset((
 SERVICE_REQUIRED = frozenset((
     "tenant", "epoch", "sched_overhead_ms", "buffered_reports",
     "pending_epochs"))
+
+# The AOT artifact-store stamp (drivers/artifacts.py): per-round
+# artifact hits vs inline compiles, and which store served them
+# (None = no store armed).  Producers with a ProgramCache (the two
+# heavy-hitters runners) stamp it every round.
+ARTIFACTS_REQUIRED = frozenset((
+    "store", "hits", "inline_compiles"))
 
 
 def _missing(block: dict, required: frozenset) -> Optional[str]:
@@ -133,6 +144,19 @@ def validate_extra(extra: dict) -> list:
         miss = _missing(mesh, MESH_REQUIRED)
         if miss:
             problems.append(f"mesh: missing {miss}")
+    artifacts = extra.get("artifacts")
+    if artifacts is not None:
+        miss = _missing(artifacts, ARTIFACTS_REQUIRED)
+        if miss:
+            problems.append(f"artifacts: missing {miss}")
+        else:
+            store = artifacts["store"]
+            if store is not None and not isinstance(store, str):
+                problems.append("artifacts.store: must be None or "
+                                "the store path")
+            for field in ("hits", "inline_compiles"):
+                if not _num(artifacts[field]):
+                    problems.append(f"artifacts.{field}: non-numeric")
     service = extra.get("service")
     if service is not None:
         miss = _missing(service, SERVICE_REQUIRED)

@@ -85,6 +85,18 @@ run mesh-all python bench.py --chunked-round-only --mesh all
 # (scheduler-overhead numbers for PERF.md).
 run serve-soak python tools/serve.py --soak 120 --bits 4 --reports 32
 
+# 6c. On-chip AOT bake + trace-free load cycle (ISSUE 9,
+# drivers/artifacts.py): bake the cold-start family on the chip,
+# then bench.py --cold-start reuses the store (MASTIC_ARTIFACT_DIR
+# under the hood) and measures fresh-process time-to-first-round,
+# traced vs warm — the cold_start_seconds / warm_store_seconds pair
+# PERF.md §11 tracks on real silicon.
+run artifacts-bake python tools/bake.py \
+    --out /tmp/mastic_aot_chip --bits 8 --rows 16 --hitters 2 \
+    --ctx "bench cold-start"
+run artifacts-cold python bench.py --cold-start \
+    --artifact-dir /tmp/mastic_aot_chip
+
 # 6b. The live status surface on the chip (ISSUE 7): the smoke
 # scenario with --status-port armed self-curls /metrics, /statusz
 # and /varz mid-run and asserts the per-tenant series, so the

@@ -213,11 +213,21 @@ def main() -> None:
                         help="serve /metrics, /statusz and /varz on "
                              "127.0.0.1:PORT (0 = ephemeral; USAGE.md "
                              "'Observability')")
+    parser.add_argument("--artifact-dir", type=str, default=None,
+                        help="AOT artifact store (tools/bake.py) — "
+                             "preloaded at startup and on tenant "
+                             "admission so rounds never trace "
+                             "(USAGE.md 'AOT artifacts'; equivalent "
+                             "to MASTIC_ARTIFACT_DIR)")
     parser.add_argument("--out", type=str, default=None)
     args = parser.parse_args()
 
     if args.resume and not args.snapshot:
         parser.error("--resume needs --snapshot PATH")
+    if args.artifact_dir:
+        # The env lever is the one seam every runner reads
+        # (drivers/artifacts.store_from_env); the flag just sets it.
+        os.environ["MASTIC_ARTIFACT_DIR"] = args.artifact_dir
     if args.mesh:
         flags = os.environ.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in flags:
@@ -319,6 +329,7 @@ def main() -> None:
         "epochs": args.epochs,
         "mesh_devices": args.mesh or 1,
         "status_port": status.port if status is not None else None,
+        "artifact_dir": args.artifact_dir,
         "wall_seconds": round(time.time() - t_start, 1),
         "results": {name: strip_wall(t["epochs"])
                     for (name, t) in metrics["tenants"].items()},
