@@ -21,12 +21,18 @@
 #                respawn; USAGE.md "Fault model & injection") —
 #                fast tier only; the full-round matrix is slow-tier
 #   make serve-smoke  collector-service gate (drivers/service.py):
-#                fast tier of tests/test_service.py (admission,
+#                fast tier of tests/test_service.py +
+#                tests/test_service_overlap.py (admission,
 #                backpressure, ingest faults, offline bit-identity
-#                incl. mid-epoch snapshot resume) plus the in-process
+#                incl. mid-epoch snapshot resume, the overlapped
+#                scheduler's interleaving discipline, and the
+#                concurrent-submit stress matrix), the in-process
 #                tools/serve.py --smoke scenario (two tenants,
 #                malformed burst, overload under both shed policies,
-#                deadline miss, crash drill)
+#                deadline miss, crash drill), and the overlapped-
+#                epoch drill (tools/serve.py --overlap-drill:
+#                concurrent submit burst through the ingest front +
+#                kill-9 + --resume with MASTIC_SERVICE_OVERLAP=2)
 #   make obs-smoke  telemetry-layer gate (mastic_tpu/obs/, ISSUE 7):
 #                tests/test_obs.py (spans, registry, schema, HTTP
 #                status surface, tracing-on/off bit-identity) plus a
@@ -73,9 +79,10 @@ faults:
 # plain fast tier's budget) but runs HERE by explicit node id — it
 # is this gate's acceptance test.
 serve-smoke:
-	$(PY) -m pytest tests/test_service.py -q -m "not slow"
+	$(PY) -m pytest tests/test_service.py tests/test_service_overlap.py -q -m "not slow"
 	$(PY) -m pytest -q "tests/test_service.py::test_epoch_bit_identical_to_offline_with_mid_epoch_resume"
 	JAX_PLATFORMS=cpu $(PY) tools/serve.py --smoke
+	JAX_PLATFORMS=cpu $(PY) tools/serve.py --overlap-drill
 
 # The status-port smoke reuses serve.py --smoke's scenario with the
 # HTTP surface armed: the run itself curls /metrics, /statusz and
@@ -118,6 +125,7 @@ test-fast:
 	$(PY) -m pytest tests/ -q -m "not slow" \
 		--ignore=tests/test_faults.py \
 		--ignore=tests/test_service.py \
+		--ignore=tests/test_service_overlap.py \
 		--ignore=tests/test_obs.py \
 		--ignore=tests/test_pipeline.py \
 		--ignore=tests/test_artifacts.py \

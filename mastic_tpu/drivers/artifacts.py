@@ -556,6 +556,55 @@ def make_baker(bm, ctx: bytes, width: int = 8, mesh=None):
     return _Baker()
 
 
+def bake_attribute_round(baker, store: ArtifactStore, rows: int,
+                         attributes: Sequence[str],
+                         with_stablehlo: bool = True) -> dict:
+    """Seal the attribute-metrics round program (ISSUE 10 satellite:
+    the from-root round rides the same artifact tier as
+    eval/agg/wc/rk).  The program bakes per (attribute set, rows,
+    mesh shape): the hashed prefixes are compile-time constants of
+    the traced round, so the key carries their digest
+    (`heavy_hitters.root_program_key`) and the serving config must
+    bake the exact attribute list it collects — a mismatch is a cache
+    miss that compiles inline, attributed, never a wrong program."""
+    import jax.numpy as jnp
+
+    from .attribute_metrics import _round_fn_masked, hash_attribute
+    from .heavy_hitters import _round_fn, root_program_key
+    from .pipeline import paused_gc
+
+    (bm, ctx, mesh) = (baker.bm, baker.ctx, baker.mesh)
+    m = bm.m
+    prefixes = tuple(hash_attribute(m, a) for a in attributes)
+    if len(set(prefixes)) != len(prefixes):
+        raise ValueError("attribute hash collision; increase BITS")
+    agg_param = (m.vidpf.BITS - 1, prefixes, True)
+    (rep, repl) = baker._mesh_sh()
+    vk = baker._sds((m.VERIFY_KEY_SIZE,), jnp.uint8, repl)
+    batch = baker._batch_structs(rows)
+    if mesh is not None:
+        shards = mesh.shape["reports"]
+        fn = _round_fn_masked(bm, ctx, agg_param, mesh)
+        structs = (vk, batch, baker._sds((rows,), jnp.bool_, rep))
+    else:
+        shards = 0
+        fn = _round_fn(bm, ctx, agg_param)
+        structs = (vk, batch)
+    key = root_program_key(bm, ctx, agg_param, rows, shards)
+    stats = {"compiled": 0, "skipped": 0, "seconds": 0.0}
+    if store.has(key):
+        stats["skipped"] = 1
+        return stats
+    t0 = time.perf_counter()
+    with paused_gc():
+        compiled = fn.lower(*structs).compile()
+    hlo = (export_stablehlo(fn, structs) if with_stablehlo else None)
+    store.save(key, compiled, stablehlo=hlo)
+    stats["compiled"] = 1
+    stats["seconds"] = time.perf_counter() - t0
+    return stats
+
+
 def bake_trajectory(baker, store: ArtifactStore, rows: int,
                     levels, with_stablehlo: bool = True) -> dict:
     """Walk one frontier trajectory, compiling and sealing every

@@ -17,7 +17,6 @@ from ..common import gen_rand
 from ..mastic import Mastic
 from ..obs import devtime, trace as obs_trace
 from ..backend.mastic_jax import BatchedMastic
-from .heavy_hitters import run_round
 
 
 def hash_attribute(mastic: Mastic, attribute: str) -> tuple:
@@ -111,9 +110,27 @@ class AttributeMetricsRun:
         rounds) — matching the step() contract of HeavyHittersRun.
         The round runs inside a "round" trace span and feeds the same
         registry series HeavyHittersRun.step does (obs/devtime), so
-        the two run kinds are diffable in one trace."""
-        if self.done:
+        the two run kinds are diffable in one trace.
+
+        ISSUE 10: `step()` is the `step_begin` / `step_finish` pair
+        run back to back; the overlapped epoch executor splits them so
+        this round's device work computes while another tenant
+        stages."""
+        handle = self.step_begin()
+        if handle is None:
             return False
+        return self.step_finish(handle)
+
+    def step_begin(self):
+        """Dispatch the single round without blocking (resident path;
+        the round program rides the AOT artifact tier via
+        heavy_hitters.root_round_program) or run it outright (chunked
+        / mesh path — ``atomic`` in the handle).  None when the run
+        already finished (a resumed completed epoch)."""
+        if self.done:
+            return None
+        from .heavy_hitters import run_round_stage
+
         m = self.mastic
         bm = BatchedMastic(m)
         level = m.vidpf.BITS - 1
@@ -130,37 +147,72 @@ class AttributeMetricsRun:
             import jax
 
             prof = jax.profiler.trace(profile_dir)
-        t0 = time.perf_counter()
+        tracer = obs_trace.get_tracer()
+        span = tracer.start_detached_span(
+            "round", tenant=self.obs_tenant, round=0,
+            level=level, frontier_width=len(self.prefixes),
+            reports=len(self.reports), profiled=bool(profile_dir))
+        handle = {"bm": bm, "agg_param": agg_param, "span": span,
+                  "prof": prof, "t0": time.perf_counter(),
+                  "atomic": True, "rh": None, "result": None}
         if prof is not None:
             prof.__enter__()
         try:
-            with obs_trace.get_tracer().span(
-                    "round", tenant=self.obs_tenant, round=0,
-                    level=level, frontier_width=len(self.prefixes),
-                    reports=len(self.reports),
-                    profiled=bool(profile_dir)):
+            with tracer.use_parent(span):
                 if chunk_size is None:
                     batch = bm.marshal_reports(self.reports)
-                    result = run_round(bm, self.verify_key, self.ctx,
-                                       agg_param, batch, self.reports,
-                                       metrics_out=self.metrics)
+                    handle["rh"] = run_round_stage(
+                        bm, self.verify_key, self.ctx, agg_param,
+                        batch)
+                    handle["atomic"] = False
                 else:
-                    result = _run_round_chunked(
+                    handle["result"] = _run_round_chunked(
                         bm, self.verify_key, self.ctx, agg_param,
                         self.reports, chunk_size, self.metrics,
                         mesh=self.mesh)
-        finally:
-            if prof is not None:
-                prof.__exit__(None, None, None)
+        except BaseException as exc:
+            self._step_cleanup(handle, error=exc)
+            raise
+        return handle
+
+    def step_finish(self, handle) -> bool:
+        """Collect the staged round (the blocking sync lives here for
+        a split handle), stamp metrics, finalize the result.  Always
+        returns False — there is exactly one round."""
+        from .heavy_hitters import run_round_collect
+
+        tracer = obs_trace.get_tracer()
+        try:
+            if not handle["atomic"]:
+                with tracer.use_parent(handle["span"]):
+                    handle["result"] = run_round_collect(
+                        handle["bm"], self.verify_key, self.ctx,
+                        handle["agg_param"], handle["rh"],
+                        reports=self.reports,
+                        metrics_out=self.metrics)
+        except BaseException as exc:
+            self._step_cleanup(handle, error=exc)
+            raise
+        self._step_cleanup(handle)
         if self.metrics:
             self.metrics[-1].extra["round_wall_ms"] = round(
-                (time.perf_counter() - t0) * 1e3, 2)
+                (time.perf_counter() - handle["t0"]) * 1e3, 2)
             self.metrics[-1].validate_extra()
             devtime.observe_round(self.metrics[-1],
                                   tenant=self.obs_tenant)
-        self._result = list(zip(self.attributes, result))
+        self._result = list(zip(self.attributes, handle["result"]))
         self.done = True
         return False
+
+    def _step_cleanup(self, handle, error=None) -> None:
+        prof = handle.pop("prof", None)
+        if prof is not None:
+            prof.__exit__(None, None, None)
+        span = handle.pop("span", None)
+        if span is not None:
+            if error is not None:
+                span.attrs.setdefault("error", type(error).__name__)
+            obs_trace.get_tracer().end_span(span)
 
     def result(self) -> list:
         return self._result
@@ -260,7 +312,9 @@ def _run_round_chunked(bm: BatchedMastic, verify_key: bytes,
 
     from ..common import vec_add
     from ..backend.schedule import LevelSchedule
-    from .heavy_hitters import _round_fn, _vk_array, finalize_round
+    from .heavy_hitters import (_artifacts_delta, _vk_array,
+                                finalize_round, root_program_cache,
+                                root_round_program)
     from .pipeline import (overlap_efficiency, paused_gc,
                            pipeline_enabled, run_chunks)
 
@@ -280,9 +334,12 @@ def _run_round_chunked(bm: BatchedMastic, verify_key: bytes,
     if mesh is not None:
         from ..parallel.mesh import place_replicated, place_reports
         vk_arr = place_replicated(mesh, vk_arr)
-        fn = _round_fn_masked(bm, ctx, agg_param, mesh)
-    else:
-        fn = _round_fn(bm, ctx, agg_param)
+    # The chunk programs ride the AOT cache/artifact tier
+    # (heavy_hitters.root_round_program, ISSUE 10): full chunks share
+    # one key, the ragged tail another — with a baked store neither
+    # traces.
+    prog_cache = root_program_cache(bm)
+    stats_mark = dict(prog_cache.stats)
     psum_bytes: list = [0]
     shard_skews: list = []
 
@@ -303,15 +360,26 @@ def _run_round_chunked(bm: BatchedMastic, verify_key: bytes,
             (batch, valid_dev) = place_reports(
                 mesh, (batch, jax.numpy.asarray(valid)))
             t_up = time.perf_counter()
-            out = fn(vk_arr, batch, valid_dev)
+            args = (vk_arr, batch, valid_dev)
         else:
             batch = bm.marshal_reports(reports[lo:hi])
             t_up = time.perf_counter()
-            out = fn(vk_arr, batch)
+            args = (vk_arr, batch)
+        before_inline = prog_cache.stats["inline_compiles"]
+        (prog, wait_s) = root_round_program(bm, ctx, agg_param, args,
+                                            mesh=mesh)
+        # The compile field carries INLINE XLA waits only — artifact
+        # loads are attributed in extra["artifacts"].load_ms, so a
+        # warm-store round keeps the zero-compile claim measurable.
+        compiled_inline = \
+            prog_cache.stats["inline_compiles"] > before_inline
+        out = prog(*args)
         t_d = time.perf_counter()
         phases = {
             "upload_ms": round((t_up - t0) * 1e3, 3),
-            "dispatch_ms": round((t_d - t_up) * 1e3, 3),
+            "compile_ms": round(wait_s * 1e3, 3) if compiled_inline
+            else 0.0,
+            "dispatch_ms": round((t_d - t_up - wait_s) * 1e3, 3),
         }
         return (out, phases)
 
@@ -374,6 +442,7 @@ def _run_round_chunked(bm: BatchedMastic, verify_key: bytes,
         checks["joint_rand"] = jr_ok
     extra = {"chunk_size": chunk_size,
              "chunks": timeline,
+             "artifacts": _artifacts_delta(prog_cache, stats_mark),
              "pipeline": {
                  "mode": "pipelined" if pipelined else "serial",
                  "fallback": (None if pipelined else

@@ -42,6 +42,7 @@ session (the collector passes it through to the party processes).
 
 import os
 import struct
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Optional
@@ -126,12 +127,20 @@ def parse_faults(text: Optional[str]) -> list:
 class FaultInjector:
     """Applies the rules addressed to one party.  Counting is per
     (rule), matched against this party's (step) events in order, so a
-    spec replays identically run to run."""
+    spec replays identically run to run.
+
+    The occurrence counters are lock-guarded (ISSUE 10): the
+    collector's ingest front fires the ``admit`` / ``page_flush``
+    checkpoints from its worker threads while the scheduler thread
+    fires the epoch checkpoints, and an unlocked read-modify-write of
+    the per-step count would let two concurrent events claim the same
+    nth (a rule firing twice, or never)."""
 
     def __init__(self, rules: list, party: str):
         self.party = party
         self.rules = [r for r in rules if r.party == party]
         self._event_counts: dict = {}
+        self._mu = threading.Lock()
 
     def _match(self, step: str) -> Optional[FaultRule]:
         """One event of (party, step) happened; the rule whose nth it
@@ -141,18 +150,23 @@ class FaultInjector:
         trace and the registry BEFORE its action runs, so even a
         `kill` is visible in the JSONL trace (ISSUE 7: an injected
         fault must be findable in the telemetry, not inferred)."""
-        n = self._event_counts.get(step, 0) + 1
-        self._event_counts[step] = n
-        for rule in self.rules:
-            if rule.step == step and not rule.fired and rule.nth == n:
-                rule.fired = True
-                obs_trace.event("fault_injected", action=rule.action,
-                                party=rule.party, step=step, nth=n)
-                get_registry().counter(
-                    "mastic_faults_injected_total",
-                    action=rule.action, step=step).inc()
-                return rule
-        return None
+        with self._mu:
+            n = self._event_counts.get(step, 0) + 1
+            self._event_counts[step] = n
+            fired = None
+            for rule in self.rules:
+                if rule.step == step and not rule.fired \
+                        and rule.nth == n:
+                    rule.fired = True
+                    fired = rule
+                    break
+        if fired is not None:
+            obs_trace.event("fault_injected", action=fired.action,
+                            party=fired.party, step=step, nth=n)
+            get_registry().counter(
+                "mastic_faults_injected_total",
+                action=fired.action, step=step).inc()
+        return fired
 
     # -- outbound frames (Channel.send_msg) ------------------------
 

@@ -75,6 +75,134 @@ def _round_fn(bm: BatchedMastic, ctx: bytes, agg_param):
     return fn
 
 
+# -- the from-root round through the AOT program tier (ISSUE 10) ------
+
+def root_program_cache(bm: BatchedMastic):
+    """The from-root round's ProgramCache, shared per BatchedMastic —
+    the artifact tier the attribute-metrics round (and the
+    incremental=False differential path) previously sat outside: its
+    per-(ctx, agg_param) jits were bare, so every fresh process (and
+    every service epoch, which builds a fresh run) re-paid the full
+    trace+XLA bill even with a warm artifact store.  Keys ride the
+    same runtime+family suffix as eval/agg/wc/rk, so `tools/bake.py
+    --attributes` seals them and the service preload at tenant
+    admission pulls them in."""
+    cache = getattr(bm, "_root_program_cache", None)
+    if cache is None:
+        from . import artifacts
+        from .pipeline import ProgramCache
+
+        cache = ProgramCache(store=artifacts.store_from_env())
+        bm._root_program_cache = cache
+    return cache
+
+
+def root_program_key(bm: BatchedMastic, ctx: bytes, agg_param,
+                     rows: int, shards: int = 0) -> tuple:
+    """Shape-and-parameter key for one from-root round program.  The
+    candidate prefixes are BAKED into the traced program (they drive
+    the gather schedule), so the key carries their digest — two
+    attribute sets of equal size map to different keys, never to each
+    other's executable."""
+    import hashlib
+
+    from . import artifacts
+
+    (level, prefixes, do_weight_check) = agg_param
+    packed = "|".join("".join("1" if b else "0" for b in p)
+                      for p in prefixes).encode()
+    digest = hashlib.sha256(packed).hexdigest()[:16]
+    return ("root", rows, shards, level, int(do_weight_check),
+            digest, artifacts.runtime_tag(),
+            artifacts.family_id(bm, ctx))
+
+
+def root_round_program(bm: BatchedMastic, ctx: bytes, agg_param,
+                       args: tuple, mesh=None) -> tuple:
+    """(program, wait_seconds) for a from-root round at the shapes of
+    `args` — the in-process tier first, the digest-sealed artifact
+    store below it, inline XLA last (attributed in the cache stats,
+    surfaced per round in `extra["artifacts"]`)."""
+    from .pipeline import to_struct
+
+    if mesh is not None:
+        from ..drivers.attribute_metrics import _round_fn_masked
+
+        fn = _round_fn_masked(bm, ctx, agg_param, mesh)
+        shards = mesh.shape["reports"]
+    else:
+        fn = _round_fn(bm, ctx, agg_param)
+        shards = 0
+    rows = int(args[1].nonces.shape[0])
+    key = root_program_key(bm, ctx, agg_param, rows, shards)
+    structs = jax.tree_util.tree_map(to_struct, args)
+    return root_program_cache(bm).get(
+        key, lambda: fn.lower(*structs))
+
+
+def _artifacts_delta(cache, mark: dict) -> dict:
+    """The per-round `extra["artifacts"]` block from a ProgramCache
+    stats snapshot taken at round start (obs/schema.py shape)."""
+    s = cache.stats
+    return {
+        "store": (cache.store.path if cache.store is not None
+                  else None),
+        "hits": s["artifact_hits"] - mark["artifact_hits"],
+        "inline_compiles": (s["inline_compiles"]
+                            - mark["inline_compiles"]),
+        "load_ms": round(s["artifact_load_ms"]
+                         - mark["artifact_load_ms"], 2),
+    }
+
+
+def run_round_stage(bm: BatchedMastic, verify_key: bytes, ctx: bytes,
+                    agg_param, batch: ReportBatch) -> dict:
+    """Dispatch one from-root round WITHOUT blocking: program fetch
+    (AOT tier), async dispatch, futures into the handle.  The paired
+    `run_round_collect` issues the round's single blocking sync — the
+    seam the overlapped epoch executor interleaves across tenants
+    (tenant B stages here while tenant A's dispatched round computes
+    on device)."""
+    from .pipeline import paused_gc
+
+    cache = root_program_cache(bm)
+    mark = dict(cache.stats)
+    args = (_vk_array(verify_key), batch)
+    with paused_gc():
+        (prog, wait_s) = root_round_program(bm, ctx, agg_param, args)
+        out = prog(*args)
+    return {"out": out, "compile_wait_s": wait_s,
+            "artifacts": _artifacts_delta(cache, mark)}
+
+
+def run_round_collect(bm: BatchedMastic, verify_key: bytes,
+                      ctx: bytes, agg_param, handle: dict,
+                      reports: Optional[list] = None,
+                      accept_out: Optional[list] = None,
+                      metrics_out: Optional[list] = None) -> list:
+    """The blocking half of `run_round_stage`: one sync, downloads,
+    the scalar-fallback splice, metrics, unshard."""
+    from ..backend.schedule import LevelSchedule
+
+    (level, prefixes, _do_weight_check) = agg_param
+    (agg0, agg1, accept, ok, checks) = handle["out"]
+    jax.block_until_ready((agg0, agg1, accept, ok))
+    accept = np.asarray(accept).copy()
+    ok = np.asarray(ok)
+    sched = LevelSchedule(prefixes, level, bm.m.vidpf.BITS)
+    agg_shares = [bm.agg_share_to_host(a) for a in (agg0, agg1)]
+    extra = {"artifacts": handle["artifacts"]}
+    result = finalize_round(
+        bm, verify_key, ctx, agg_param, reports, ok, accept,
+        {k: np.asarray(v) for (k, v) in checks.items()}, agg_shares,
+        padded_width=sched.total_nodes,
+        nodes_evaluated=sched.total_nodes, metrics_out=metrics_out,
+        extra=extra)
+    if accept_out is not None:
+        accept_out.append(accept)
+    return result
+
+
 def run_round(bm: BatchedMastic, verify_key: bytes, ctx: bytes,
               agg_param, batch: ReportBatch,
               reports: Optional[list] = None,
@@ -88,24 +216,14 @@ def run_round(bm: BatchedMastic, verify_key: bytes, ctx: bytes,
 
     `reports` is the host-side report list backing `batch`; it is only
     touched when XOF rejection sampling fires for some lane (the scalar
-    fallback, see `splice_rejected`)."""
-    from ..backend.schedule import LevelSchedule
-
-    (level, prefixes, do_weight_check) = agg_param
-    (agg0, agg1, accept, ok, checks) = _round_fn(bm, ctx, agg_param)(
-        _vk_array(verify_key), batch)
-    accept = np.asarray(accept).copy()
-    ok = np.asarray(ok)
-    sched = LevelSchedule(prefixes, level, bm.m.vidpf.BITS)
-    agg_shares = [bm.agg_share_to_host(a) for a in (agg0, agg1)]
-    result = finalize_round(
-        bm, verify_key, ctx, agg_param, reports, ok, accept,
-        {k: np.asarray(v) for (k, v) in checks.items()}, agg_shares,
-        padded_width=sched.total_nodes,
-        nodes_evaluated=sched.total_nodes, metrics_out=metrics_out)
-    if accept_out is not None:
-        accept_out.append(accept)
-    return result
+    fallback, see `splice_rejected`).  Since ISSUE 10 the round
+    program rides the AOT artifact tier (`root_round_program`), and
+    the round itself is the stage/collect pair the overlapped epoch
+    executor splits."""
+    handle = run_round_stage(bm, verify_key, ctx, agg_param, batch)
+    return run_round_collect(bm, verify_key, ctx, agg_param, handle,
+                             reports=reports, accept_out=accept_out,
+                             metrics_out=metrics_out)
 
 
 def finalize_round(bm: BatchedMastic, verify_key: bytes, ctx: bytes,
@@ -339,45 +457,89 @@ class HeavyHittersRun:
         — or once per process when `MASTIC_JAX_PROFILE=dir` is armed
         — the round executes under jax.profiler.trace; open the
         result with TensorBoard / xprof.  Per-round wall-clock always
-        lands in metrics.extra["round_wall_ms"]."""
-        if self.done:
+        lands in metrics.extra["round_wall_ms"].
+
+        ISSUE 10: `step()` is the `step_begin` / `step_finish` pair
+        run back to back.  The overlapped epoch executor calls the
+        halves split across tenants — begin dispatches this level's
+        round without blocking, finish issues the one blocking sync
+        and advances the frontier."""
+        handle = self.step_begin()
+        if handle is None:
             return False
+        return self.step_finish(handle)
+
+    def step_begin(self) -> Optional[dict]:
+        """Dispatch one level's round without blocking (resident
+        runner) or run it outright (chunked / from-root, where the
+        intra-round pipeline owns the sync discipline — the handle's
+        ``atomic`` flag says which happened).  Returns None when no
+        rounds remain.  Every handle MUST be passed to `step_finish`
+        — the frontier only advances there."""
+        if self.done:
+            return None
         if not self.prefixes:
             self.done = True
-            return False
+            return None
         level = self.level
         agg_param = (level, tuple(self.prefixes), level == 0)
         assert self.mastic.is_valid(agg_param, self.prev_agg_params)
         profile_dir = self.profile_dir or devtime.take_profile_dir()
         prof = (jax.profiler.trace(profile_dir)
                 if profile_dir else None)
-        t0 = time.perf_counter()
+        tracer = obs_trace.get_tracer()
+        span = tracer.start_detached_span(
+            "round", tenant=self.obs_tenant, round=level,
+            level=level, frontier_width=len(self.prefixes),
+            reports=self.num_reports, profiled=bool(profile_dir))
+        handle = {"agg_param": agg_param, "span": span, "prof": prof,
+                  "t0": time.perf_counter(), "atomic": True,
+                  "rh": None, "result": None}
         if prof is not None:
             prof.__enter__()
         try:
-            with obs_trace.get_tracer().span(
-                    "round", tenant=self.obs_tenant, round=level,
-                    level=level, frontier_width=len(self.prefixes),
-                    reports=self.num_reports,
-                    profiled=bool(profile_dir)):
-                if self.runner is not None:
-                    agg_result = self.runner.round(
+            with tracer.use_parent(span):
+                if isinstance(self.runner, _IncrementalRunner):
+                    # The resident round splits at the sync seam: the
+                    # handle holds in-flight futures, finish() blocks.
+                    handle["rh"] = self.runner.round_stage(agg_param)
+                    handle["atomic"] = False
+                elif self.runner is not None:
+                    handle["result"] = self.runner.round(
                         agg_param, metrics_out=self.metrics)
                 else:
-                    agg_result = run_round(
+                    handle["result"] = run_round(
                         self.bm, self.verify_key, self.ctx,
                         agg_param, self.batch, self.reports,
                         metrics_out=self.metrics)
-        finally:
-            if prof is not None:
-                prof.__exit__(None, None, None)
+        except BaseException as exc:
+            self._step_cleanup(handle, error=exc)
+            raise
+        return handle
+
+    def step_finish(self, handle: dict) -> bool:
+        """Collect the staged round (blocking sync for a split
+        handle), stamp its metrics, and advance the frontier.
+        Returns True while more rounds remain."""
+        tracer = obs_trace.get_tracer()
+        try:
+            if not handle["atomic"]:
+                with tracer.use_parent(handle["span"]):
+                    handle["result"] = self.runner.round_collect(
+                        handle["rh"], metrics_out=self.metrics)
+        except BaseException as exc:
+            self._step_cleanup(handle, error=exc)
+            raise
+        agg_result = handle["result"]
+        self._step_cleanup(handle)
         if self.metrics:
             self.metrics[-1].extra["round_wall_ms"] = round(
-                (time.perf_counter() - t0) * 1e3, 2)
+                (time.perf_counter() - handle["t0"]) * 1e3, 2)
             self.metrics[-1].validate_extra()
             devtime.observe_round(self.metrics[-1],
                                   tenant=self.obs_tenant)
-        self.prev_agg_params.append(agg_param)
+        (level, _prefixes, _wc) = handle["agg_param"]
+        self.prev_agg_params.append(handle["agg_param"])
 
         survivors = [
             prefix for (prefix, count) in zip(self.prefixes, agg_result)
@@ -392,6 +554,18 @@ class HeavyHittersRun:
         if self.level >= self.mastic.vidpf.BITS or not self.prefixes:
             self.done = True
         return not self.done
+
+    def _step_cleanup(self, handle: dict, error=None) -> None:
+        """Close the round's profiler bracket and trace span exactly
+        once (both halves may hit an exception path)."""
+        prof = handle.pop("prof", None)
+        if prof is not None:
+            prof.__exit__(None, None, None)
+        span = handle.pop("span", None)
+        if span is not None:
+            if error is not None:
+                span.attrs.setdefault("error", type(error).__name__)
+            obs_trace.get_tracer().end_span(span)
 
     def result(self) -> list:
         return self.heavy_hitters
@@ -1143,15 +1317,16 @@ class _IncrementalRunner(RoundPrograms):
         self._eval_fn = None
         self._combine_fn = None
 
-    def round(self, agg_param,
-              metrics_out: Optional[list] = None) -> list:
-        """One resident round, pipelined-executor style: the whole
-        eval -> weight-check -> mask-combine -> aggregate chain is
-        dispatched asynchronously (device-side accept combine instead
-        of host boolean folds), the predicted next level's programs
-        warm in the background, and ONE blocking sync collects
-        everything — the per-phase timeline lands in
-        `RoundMetrics.extra["pipeline"]`."""
+    def round_stage(self, agg_param) -> dict:
+        """The non-blocking half of one resident round: plan, program
+        fetch, async dispatch of the whole eval -> weight-check ->
+        mask-combine -> aggregate chain, the predicted-next-level
+        warm slot, and the carry handover — everything short of the
+        blocking sync.  Returns the in-flight handle
+        `round_collect` consumes.  The overlapped epoch executor
+        (drivers/service.py, ISSUE 10) calls the pair split across
+        tenants: another tenant's stage runs here while this handle's
+        device work computes."""
         from ..backend.incremental import round_inputs
         from .chunked import check_round_peak
 
@@ -1189,6 +1364,7 @@ class _IncrementalRunner(RoundPrograms):
 
             args = (vk_arr, self.carries[0], self.carries[1], rnd,
                     self.ext_rk, self.conv_rk, self.batch.cws)
+            inline_before = self.programs.stats["inline_compiles"]
             (eval_prog, compile_s) = self._eval_program(
                 self.num_reports, plan, args)
             t_disp0 = time.perf_counter()
@@ -1221,9 +1397,39 @@ class _IncrementalRunner(RoundPrograms):
         self.carries = [c0, c1]
         assert level == len(self.layouts)
         self.layouts.append(plan.layout_new)
+        return {
+            "agg_param": agg_param, "plan": plan,
+            "accept_dev": accept_dev, "agg0": agg0, "agg1": agg1,
+            "ok": ok, "wc_okdev": wc_okdev, "accept_ev": accept_ev,
+            "wc_checks": wc_checks,
+            "compile_s": (compile_s, wc_compile_s, agg_compile_s),
+            # Whether any of this round's program fetches actually
+            # paid an inline XLA compile — an artifact-store load's
+            # wait is attributed in extra["artifacts"].load_ms, and
+            # the timeline compile field stays an inline-only claim.
+            "compiled_inline": (self.programs.stats["inline_compiles"]
+                                > inline_before),
+            "warm_s": warm_s,
+            "t": (t0, t_up, t_disp0, t_disp1, t_warm),
+        }
 
-        # The round's single blocking sync: everything above is an
-        # in-flight future until here.
+    def round_collect(self, handle: dict,
+                      metrics_out: Optional[list] = None) -> list:
+        """The blocking half: the round's SINGLE sync, downloads, the
+        scalar-fallback splice, metrics.  Everything in the handle is
+        an in-flight future until here."""
+        (level, prefixes, do_weight_check) = handle["agg_param"]
+        agg_param = handle["agg_param"]
+        plan = handle["plan"]
+        (accept_dev, agg0, agg1) = (handle["accept_dev"],
+                                    handle["agg0"], handle["agg1"])
+        (ok, wc_okdev) = (handle["ok"], handle["wc_okdev"])
+        (accept_ev, wc_checks) = (handle["accept_ev"],
+                                  handle["wc_checks"])
+        (compile_s, wc_compile_s, agg_compile_s) = handle["compile_s"]
+        warm_s = handle["warm_s"]
+        (t0, t_up, t_disp0, t_disp1, t_warm) = handle["t"]
+
         shard_skew = None
         if self.mesh is not None \
                 and self.mesh.shape["reports"] > 1:
@@ -1273,7 +1479,11 @@ class _IncrementalRunner(RoundPrograms):
         metrics.xof_fallbacks = int(self.fallback.sum())
         metrics.rejected_fallback = int((self.fallback & ~accept).sum())
         t_host = time.perf_counter()
-        compile_ms = (compile_s + agg_compile_s + wc_compile_s) * 1e3
+        # Inline-compile waits only: when every program came from the
+        # cache/store tiers, the (small) fetch waits stay out of the
+        # compile field — `artifacts.load_ms` attributes them.
+        compile_ms = ((compile_s + agg_compile_s + wc_compile_s) * 1e3
+                      if handle["compiled_inline"] else 0.0)
         metrics.extra["artifacts"] = self._artifacts_block()
         if self.mesh is not None:
             metrics.extra["mesh"] = {
@@ -1310,3 +1520,17 @@ class _IncrementalRunner(RoundPrograms):
             metrics_out.append(metrics)
         num = int(accept.sum())
         return self.bm.m.unshard(agg_param, agg_shares, num)
+
+    def round(self, agg_param,
+              metrics_out: Optional[list] = None) -> list:
+        """One resident round, pipelined-executor style: the whole
+        eval -> weight-check -> mask-combine -> aggregate chain is
+        dispatched asynchronously (device-side accept combine instead
+        of host boolean folds), the predicted next level's programs
+        warm in the background, and ONE blocking sync collects
+        everything — the per-phase timeline lands in
+        `RoundMetrics.extra["pipeline"]`.  `round_stage` /
+        `round_collect` are the same round split at the sync seam
+        (the overlapped epoch executor's unit of interleaving)."""
+        return self.round_collect(self.round_stage(agg_param),
+                                  metrics_out=metrics_out)

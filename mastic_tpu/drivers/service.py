@@ -66,11 +66,44 @@ and its ``corrupt`` / ``truncate`` actions mutate the sealed page's
 stored bytes AFTER the digest is taken — modeling storage corruption,
 which the digest check must catch — and checkpoints ``epoch_start`` /
 ``epoch_round`` / ``snapshot`` fire in the scheduler.
+
+ISSUE 10 adds the two concurrency layers the r11 scheduler left on
+the table:
+
+* **overlapped epoch execution** (`MASTIC_SERVICE_OVERLAP` = K >= 2)
+  — the scheduler keeps up to K tenants' rounds in flight by
+  splitting each round at the r9 stage/collect seam
+  (`CollectionRun.step_begin` dispatches without blocking,
+  `step_finish` issues the round's one blocking sync): tenant B's
+  host-side stage (page decode, upload prep, AOT program fetch,
+  dispatch) runs while tenant A's dispatched round computes on
+  device.  Rounds of one tenant never overlap each other, so every
+  tenant's round sequence — and therefore its results — is
+  bit-identical to the serial round-robin path; run kinds without a
+  split seam (chunked runs, whose intra-round pipeline owns the sync
+  discipline) execute atomically inside their quantum, named in the
+  service metrics.  `to_bytes()` drains in-flight rounds first: a
+  snapshot is always a quiescent point;
+
+* **concurrent ingest front** (`MASTIC_SERVICE_INGEST_THREADS` >= 1)
+  — `submit()` becomes a bounded-queue enqueue
+  (`MASTIC_SERVICE_INGEST_QUEUE`; a full queue sheds with reason
+  ``ingest-queue-full``, counted, never silent) and a small worker
+  pool decode-validates both party views off-thread, landing sealed
+  pages into the same digest-sealed buffers under the tenant's
+  admission lock — so `submit()` never blocks on round execution and
+  admission no longer serializes with the scheduler.  Shed policies,
+  quotas, and quarantine semantics are unchanged; every counter
+  increment is race-safe (`ServiceCounters` locks itself, tenant
+  buffer state mutates only under `_Tenant.lock`), which the r13
+  concurrency pass (CC001-CC004) proves over the whole program.
 """
 
 import abc
 import hashlib
 import json
+import queue as queue_mod
+import threading
 import time
 from dataclasses import dataclass
 from typing import Optional
@@ -82,6 +115,7 @@ from ..metrics import ServiceCounters
 from ..obs import trace as obs_trace
 from ..obs.registry import get_registry
 from . import faults as faults_mod
+from .pipeline import overlap_efficiency
 from .session import Deadline, _env_float, _env_int
 from .parties import (REASON_MALFORMED, REASON_NAMES, REASON_RANGE,
                       instantiate)
@@ -101,6 +135,11 @@ SHED_POLICIES = ("reject-newest", "oldest-epoch-first")
 ADMITTED = "admitted"
 QUARANTINED = "quarantined"
 SHED = "shed"
+# With the concurrent ingest front armed, submit() enqueues and the
+# admission verdict lands asynchronously (in the counters / events);
+# a caller that needs the verdict synchronously runs with the front
+# off, exactly as before.
+QUEUED = "queued"
 
 _SNAPSHOT_VERSION = 1
 
@@ -145,6 +184,17 @@ class CollectionRun(abc.ABC):
     def to_bytes(self) -> bytes:
         """Checkpoint between rounds (resume must be bit-identical)."""
 
+    # Optional split-phase protocol (ISSUE 10): runs that can split a
+    # round at the stage/collect seam additionally provide
+    #   step_begin() -> handle | None   (dispatch, non-blocking; the
+    #                                    handle's "atomic" flag is
+    #                                    True when the round ran
+    #                                    outright instead)
+    #   step_finish(handle) -> bool     (blocking sync + advance)
+    # with step() == step_begin()+step_finish().  The overlapped
+    # epoch executor feature-detects them (getattr) so legacy run
+    # kinds — and test stubs — keep working atomically.
+
 
 CollectionRun.register(HeavyHittersRun)
 CollectionRun.register(AttributeMetricsRun)
@@ -173,6 +223,11 @@ class ServiceConfig:
     quarantine_limit: int = 64    # per-tenant; past it, suspend
     epoch_deadline: float = 1800.0
     epoch_retries: int = 1        # extra attempts for a failing round
+    overlap: int = 0              # tenants' rounds in flight (<2 =
+    #                               serial round-robin, the r11 path)
+    ingest_threads: int = 0       # concurrent ingest front (0 = off:
+    #                               submit() admits in-process)
+    ingest_queue: int = 256       # bounded ingest queue (uploads)
 
     def __post_init__(self):
         if self.shed_policy not in SHED_POLICIES:
@@ -181,6 +236,10 @@ class ServiceConfig:
                 f"one of {', '.join(SHED_POLICIES)})")
         if self.page_size < 1:
             raise ValueError("page_size must be >= 1")
+        if self.ingest_queue < 1:
+            raise ValueError("ingest_queue must be >= 1")
+        if self.overlap < 0 or self.ingest_threads < 0:
+            raise ValueError("overlap / ingest_threads must be >= 0")
 
     @classmethod
     def from_env(cls) -> "ServiceConfig":
@@ -196,6 +255,10 @@ class ServiceConfig:
                 "MASTIC_SERVICE_EPOCH_DEADLINE",
                 _env_float("MASTIC_ROUND_DEADLINE", 1800.0)),
             epoch_retries=_env_int("MASTIC_SERVICE_EPOCH_RETRIES", 1),
+            overlap=_env_int("MASTIC_SERVICE_OVERLAP", 0),
+            ingest_threads=_env_int("MASTIC_SERVICE_INGEST_THREADS",
+                                    0),
+            ingest_queue=_env_int("MASTIC_SERVICE_INGEST_QUEUE", 256),
         )
 
 
@@ -408,11 +471,22 @@ class _Epoch:
 
 
 class _Tenant:
+    """One tenant's state AND its admission path (ISSUE 10): the
+    quota / quarantine / page machinery lives here, on the tenant,
+    because ingest workers and the scheduler thread both walk it —
+    every buffer mutation happens under `self.lock`, the effective
+    limits are resolved once at construction (spec override falling
+    back to the service config), and the ServiceCounters ledger locks
+    itself."""
+
     __slots__ = ("spec", "mastic", "open_page", "sealed", "pending",
                  "active", "completed", "counters", "epoch_seq",
-                 "suspended", "last_timeline")
+                 "suspended", "last_timeline", "lock",
+                 "eff_page_size", "eff_max_buffered",
+                 "eff_quarantine_limit", "eff_epoch_deadline",
+                 "eff_shed_policy")
 
-    def __init__(self, spec: TenantSpec):
+    def __init__(self, spec: TenantSpec, config: ServiceConfig):
         self.spec = spec
         self.mastic = instantiate(spec.spec)
         self.open_page = ReportPage()
@@ -427,6 +501,25 @@ class _Tenant:
         self.epoch_seq = 0
         self.suspended = False
         self.last_timeline: Optional[list] = None  # statusz surface
+        # The admission lock (ISSUE 10): every mutation of the
+        # tenant's buffer state (open_page, sealed, pending,
+        # suspended, active) happens under it — ingest workers land
+        # pages while the scheduler thread cuts epochs and retires
+        # them.  Pure reads (occupancy gauges) stay lock-free.
+        self.lock = threading.Lock()
+        # Effective limits, resolved once: admission never has to
+        # reach back into the (main-thread-owned) service config.
+        self.eff_page_size = spec.page_size or config.page_size
+        self.eff_max_buffered = (spec.max_buffered
+                                 or config.max_buffered)
+        self.eff_quarantine_limit = (
+            spec.quarantine_limit
+            if spec.quarantine_limit is not None
+            else config.quarantine_limit)
+        self.eff_epoch_deadline = (
+            spec.epoch_deadline if spec.epoch_deadline is not None
+            else config.epoch_deadline)
+        self.eff_shed_policy = config.shed_policy
 
     def buffered_reports(self) -> int:
         """Reports the tenant holds admitted-but-unfinished — the
@@ -438,6 +531,188 @@ class _Tenant:
         if self.active is not None:
             total += self.active.report_count()
         return total
+
+    # -- admission (any thread; ISSUE 10) --------------------------
+
+    def admit_decoded(self, blob: bytes,
+                      decode_exc: Optional[Exception],
+                      injector=None) -> tuple:
+        """The admission verdict, under the tenant's lock: suspended
+        -> shed; malformed -> reason-coded quarantine (suspension
+        past the limit); over-quota -> shed policy; else land in the
+        open page.  Trace events emit after the lock releases, and a
+        full page seals outside it (the digest hash and the
+        page_flush fault — which may legitimately stall — must not
+        hold up concurrent admission)."""
+        name = self.spec.name
+        events: list = []
+        to_seal: Optional[ReportPage] = None
+        with self.lock:
+            if self.suspended:
+                self.counters.inc("shed")
+                self.counters.bump_shed("tenant-quarantined")
+                verdict = (SHED, "tenant-quarantined")
+                events.append(
+                    ("shed", {"tenant": name,
+                              "reason": "tenant-quarantined"}))
+            elif decode_exc is not None:
+                reason = SERVICE_REASON_NAMES[
+                    _decode_reason(decode_exc)]
+                self.counters.inc("quarantined")
+                self.counters.bump_quarantine(reason)
+                events.append(("quarantine", {"tenant": name,
+                                              "reason": reason}))
+                if self.counters.quarantined \
+                        >= self.eff_quarantine_limit:
+                    self.suspended = True
+                    events.append((
+                        "tenant_suspended",
+                        {"tenant": name,
+                         "quarantined": self.counters.quarantined}))
+                verdict = (QUARANTINED, reason)
+            else:
+                verdict = None
+                if self.buffered_reports() >= self.eff_max_buffered:
+                    # oldest-epoch-first may make room by dropping a
+                    # queued epoch; if the buffer is still over quota
+                    # after that (or the policy is reject-newest),
+                    # the incoming upload sheds.
+                    self.shed_oldest()
+                    if self.buffered_reports() \
+                            >= self.eff_max_buffered:
+                        self.counters.inc("shed")
+                        self.counters.bump_shed("reject-newest")
+                        events.append(
+                            ("shed", {"tenant": name,
+                                      "reason": "reject-newest"}))
+                        verdict = (SHED, "reject-newest")
+                if verdict is None:
+                    self.open_page.append(blob)
+                    self.counters.inc("admitted")
+                    if self.open_page.count >= self.eff_page_size:
+                        to_seal = self.open_page
+                        self.open_page = ReportPage()
+                    verdict = (ADMITTED, "")
+        if to_seal is not None:
+            self.seal_page(to_seal, injector)
+        for (ev_name, attrs) in events:
+            obs_trace.event(ev_name, **attrs)
+        return verdict
+
+    def shed_oldest(self) -> Optional[str]:
+        """Over-quota relief under the tenant's effective policy
+        (caller holds `self.lock`).  Returns the shed detail when
+        room was made (oldest-epoch-first), None when the incoming
+        upload itself must be rejected."""
+        if self.eff_shed_policy != "oldest-epoch-first" \
+                or not self.pending:
+            return None
+        victim = self.pending.pop(0)
+        lost = victim.report_count()
+        self.counters.inc("shed", lost)
+        self.counters.bump_shed("oldest-epoch-first", lost)
+        obs_trace.event("shed", tenant=self.spec.name,
+                        reason="oldest-epoch-first", reports=lost,
+                        epoch=victim.epoch_id)
+        return f"oldest-epoch-first dropped epoch {victim.epoch_id} " \
+               f"({lost} reports)"
+
+    def seal_page(self, page: ReportPage, injector=None) -> None:
+        """Seal one just-swapped-out page behind its digest and
+        append it to the sealed list.  Called WITHOUT the lock — the
+        page left the open slot atomically, so no other thread can
+        reach it, and the `page_flush` fault's delay/hang actions
+        must stall only this admission, not the tenant."""
+        page.seal()
+        if injector is not None:
+            # One fault event per seal: kill/hang/delay fire as
+            # process faults, truncate/corrupt mutate the stored
+            # bytes AFTER the digest (storage-corruption model — the
+            # verify() gate must catch it downstream).
+            page.payload = injector.on_blob("page_flush",
+                                            page.payload)
+        with self.lock:
+            self.sealed.append(page)
+        self.counters.inc("pages_sealed")
+
+
+# -- the concurrent ingest front --------------------------------------
+
+class _IngestFront:
+    """The admission thread pool (ISSUE 10): `submit()` enqueues raw
+    upload blobs into a BOUNDED queue and returns immediately;
+    workers pop, decode-validate both party views (the expensive wire
+    work, outside any lock), and land the verdict through the
+    tenant's admission lock — so admission never blocks on round
+    execution and the scheduler thread never pays upload decode.
+
+    Bounds and failure behavior: the queue holds at most
+    `ServiceConfig.ingest_queue` uploads (a full queue is the
+    caller's shed, reason ``ingest-queue-full`` — counted by
+    `CollectorService.submit`, never silent); `flush()` blocks until
+    every queued upload has fully landed (the epoch-cut barrier);
+    `stop()` retires the workers.  Workers are daemon threads: a
+    crashing process never hangs on them, and the service snapshot
+    flushes first so no admitted upload is in limbo at snapshot
+    time."""
+
+    def __init__(self, svc: "CollectorService", threads: int,
+                 queue_bound: int):
+        self._svc = svc
+        self.queue: queue_mod.Queue = queue_mod.Queue(
+            maxsize=max(1, queue_bound))
+        self._stop = threading.Event()
+        self.threads = [
+            threading.Thread(target=self._worker,
+                             name=f"mastic-ingest-{i}", daemon=True)
+            for i in range(max(1, threads))
+        ]
+        for th in self.threads:
+            th.start()
+
+    def offer(self, tenant: str, blob: bytes) -> bool:
+        """Enqueue one upload; False when the bounded queue is full
+        (the caller sheds, attributed)."""
+        try:
+            self.queue.put_nowait((tenant, blob))
+            return True
+        except queue_mod.Full:
+            return False
+
+    def _worker(self) -> None:
+        # The 0.1 s poll bounds the loop (stop() lands within one
+        # tick); queue.get itself carries the timeout, so a stopped
+        # front never wedges on an empty queue.
+        while not self._stop.is_set():
+            try:
+                item = self.queue.get(timeout=0.1)
+            except queue_mod.Empty:
+                item = None
+            if item is None:
+                continue
+            (tenant, blob) = item
+            try:
+                self._svc._ingest_one(tenant, blob)
+            except Exception as exc:
+                # A worker must survive anything one hostile upload
+                # can throw — the blob is dropped ATTRIBUTED (decode
+                # errors proper are quarantined inside _ingest_one;
+                # this is the belt over it).
+                obs_trace.event("ingest_error", tenant=tenant,
+                                error=type(exc).__name__)
+            finally:
+                self.queue.task_done()
+
+    def flush(self) -> None:
+        """Block until every enqueued upload has fully landed (pages
+        appended, counters settled) — the barrier `begin_epoch` and
+        the snapshot run before touching buffered state."""
+        self.queue.join()
+
+    def stop(self) -> None:
+        self._stop.set()
+        for th in self.threads:
+            th.join(timeout=5.0)
 
 
 # -- the service ------------------------------------------------------
@@ -460,15 +735,49 @@ class CollectorService:
         for spec in tenants:
             if spec.name in self.tenants:
                 raise ValueError(f"duplicate tenant {spec.name!r}")
-            self.tenants[spec.name] = _Tenant(spec)
+            self.tenants[spec.name] = _Tenant(spec, self.config)
         self._rr = 0   # round-robin cursor over tenant order
         self.resumed = False
+        # Guards the tenant table itself: add_tenant publishes a new
+        # entry while ingest workers look tenants up by name.
+        self._tenants_mu = threading.Lock()
+        # Overlapped epoch executor state (ISSUE 10): in-flight
+        # staged rounds, oldest first — owned by the scheduler
+        # thread; at most one entry per tenant.
+        self._inflight: list = []
+        self._sched_window: Optional[dict] = None
+        # Concurrent ingest front: armed by config, stoppable
+        # (stop_ingest) so tests and drains can quiesce it.
+        self._ingest: Optional[_IngestFront] = None
+        if self.config.ingest_threads > 0:
+            self._ingest = _IngestFront(self,
+                                        self.config.ingest_threads,
+                                        self.config.ingest_queue)
         # Warm AOT artifact store (drivers/artifacts.py): preload
         # every tenant's program family at boot so the first epoch of
         # each never traces — the ROADMAP item 4 enabler for epoch
         # overlap and containerized serving.
         for t in self.tenants.values():
             self._preload_artifacts(t)
+
+    def stop_ingest(self) -> None:
+        """Quiesce the ingest front: land everything queued, retire
+        the workers.  Idempotent; submit() admits in-process after."""
+        if self._ingest is not None:
+            self._ingest.flush()
+            self._ingest.stop()
+            self._ingest = None
+
+    def flush_ingest(self) -> None:
+        """Barrier: every upload submitted so far has fully landed
+        (admitted / quarantined / shed) when this returns."""
+        if self._ingest is not None:
+            self._ingest.flush()
+
+    def inflight_rounds(self) -> int:
+        """Staged-but-uncollected rounds (0 outside overlap mode —
+        the serve.py snapshot cadence keys on this)."""
+        return len(self._inflight)
 
     def add_tenant(self, spec: TenantSpec) -> None:
         """Admit a new collection tenant into the running service
@@ -478,8 +787,9 @@ class CollectorService:
         admission time, not a trace at epoch time."""
         if spec.name in self.tenants:
             raise ValueError(f"duplicate tenant {spec.name!r}")
-        t = _Tenant(spec)
-        self.tenants[spec.name] = t
+        t = _Tenant(spec, self.config)
+        with self._tenants_mu:
+            self.tenants[spec.name] = t
         self._preload_artifacts(t)
 
     def _preload_artifacts(self, t: _Tenant) -> None:
@@ -499,24 +809,6 @@ class CollectorService:
             obs_trace.event("artifact_preload", tenant=t.spec.name,
                             store=store.path, **counts)
 
-    # -- small config helpers --------------------------------------
-
-    def _page_size(self, t: _Tenant) -> int:
-        return t.spec.page_size or self.config.page_size
-
-    def _max_buffered(self, t: _Tenant) -> int:
-        return t.spec.max_buffered or self.config.max_buffered
-
-    def _quarantine_limit(self, t: _Tenant) -> int:
-        return (t.spec.quarantine_limit
-                if t.spec.quarantine_limit is not None
-                else self.config.quarantine_limit)
-
-    def _epoch_deadline(self, t: _Tenant) -> float:
-        return (t.spec.epoch_deadline
-                if t.spec.epoch_deadline is not None
-                else self.config.epoch_deadline)
-
     def _checkpoint(self, step: str) -> None:
         if self.injector is not None:
             self.injector.checkpoint(step)
@@ -525,78 +817,45 @@ class CollectorService:
 
     def submit(self, tenant: str, blob: bytes) -> tuple:
         """Admit one upload blob for `tenant`.  Returns (status,
-        detail): ADMITTED, QUARANTINED (detail = reason name), or
-        SHED (detail = policy / reason).  Never raises for bad input
-        — a hostile upload must cost the service one decode, not an
-        exception path."""
+        detail): ADMITTED, QUARANTINED (detail = reason name), SHED
+        (detail = policy / reason), or — with the concurrent ingest
+        front armed — QUEUED (the verdict lands asynchronously in the
+        counters).  Never raises for bad input — a hostile upload
+        must cost the service one decode, not an exception path."""
+        t = self.tenants[tenant]
+        if self._ingest is not None:
+            # The front path: enqueue only.  submit() never blocks on
+            # decode OR round execution; a full queue is explicit
+            # backpressure, shed with its own reason.
+            if self._ingest.offer(tenant, blob):
+                return (QUEUED, "")
+            with t.lock:
+                t.counters.inc("shed")
+                t.counters.bump_shed("ingest-queue-full")
+            obs_trace.event("shed", tenant=tenant,
+                            reason="ingest-queue-full")
+            return (SHED, "ingest-queue-full")
+        return self._ingest_one(tenant, blob)
+
+    def _ingest_one(self, tenant: str, blob: bytes) -> tuple:
+        """Decode-validate one upload and land the verdict — the
+        in-process submit body, also the ingest workers' unit of
+        work.  Decode runs OUTSIDE the admission lock (it is the
+        expensive part and touches no shared state); everything that
+        mutates tenant buffers goes through _Tenant.admit_decoded."""
         t = self.tenants[tenant]
         self._checkpoint("admit")
-        if t.suspended:
-            t.counters.inc("shed")
-            t.counters.bump_shed("tenant-quarantined")
-            obs_trace.event("shed", tenant=tenant,
-                            reason="tenant-quarantined")
-            return (SHED, "tenant-quarantined")
-        try:
-            decode_upload(t.mastic, blob)
-        except (ValueError, EOFError) as exc:
-            reason = _decode_reason(exc)
-            t.counters.inc("quarantined")
-            t.counters.bump_quarantine(SERVICE_REASON_NAMES[reason])
-            obs_trace.event("quarantine", tenant=tenant,
-                            reason=SERVICE_REASON_NAMES[reason])
-            if t.counters.quarantined >= self._quarantine_limit(t):
-                t.suspended = True
-                obs_trace.event("tenant_suspended", tenant=tenant,
-                                quarantined=t.counters.quarantined)
-            return (QUARANTINED, SERVICE_REASON_NAMES[reason])
-        if t.buffered_reports() >= self._max_buffered(t):
-            # oldest-epoch-first may make room by dropping a queued
-            # epoch; if the buffer is still over quota after that (or
-            # the policy is reject-newest), the incoming upload sheds.
-            self._shed(t)
-            if t.buffered_reports() >= self._max_buffered(t):
-                t.counters.inc("shed")
-                t.counters.bump_shed("reject-newest")
-                obs_trace.event("shed", tenant=tenant,
-                                reason="reject-newest")
-                return (SHED, "reject-newest")
-        t.open_page.append(blob)
-        t.counters.inc("admitted")
-        if t.open_page.count >= self._page_size(t):
-            self._seal_open_page(t)
-        return (ADMITTED, "")
-
-    def _shed(self, t: _Tenant) -> Optional[str]:
-        """Over-quota relief under the configured policy.  Returns the
-        shed detail when room was made (oldest-epoch-first), None when
-        the incoming upload itself must be rejected."""
-        if self.config.shed_policy != "oldest-epoch-first" \
-                or not t.pending:
-            return None
-        victim = t.pending.pop(0)
-        lost = victim.report_count()
-        t.counters.inc("shed", lost)
-        t.counters.bump_shed("oldest-epoch-first", lost)
-        obs_trace.event("shed", tenant=t.spec.name,
-                        reason="oldest-epoch-first", reports=lost,
-                        epoch=victim.epoch_id)
-        return f"oldest-epoch-first dropped epoch {victim.epoch_id} " \
-               f"({lost} reports)"
-
-    def _seal_open_page(self, t: _Tenant) -> None:
-        page = t.open_page
-        t.open_page = ReportPage()
-        page.seal()
-        if self.injector is not None:
-            # One fault event per seal: kill/hang/delay fire as
-            # process faults, truncate/corrupt mutate the stored
-            # bytes AFTER the digest (storage-corruption model — the
-            # verify() gate must catch it downstream).
-            page.payload = self.injector.on_blob("page_flush",
-                                                 page.payload)
-        t.sealed.append(page)
-        t.counters.inc("pages_sealed")
+        decode_exc: Optional[Exception] = None
+        if not t.suspended:
+            # Racy pre-check only — it saves the decode for a
+            # suspended tenant; admit_decoded re-checks under the
+            # lock either way.
+            try:
+                decode_upload(t.mastic, blob)
+            except (ValueError, EOFError) as exc:
+                decode_exc = exc
+        return t.admit_decoded(blob, decode_exc,
+                               injector=self.injector)
 
     # -- epochs ----------------------------------------------------
 
@@ -604,23 +863,34 @@ class CollectorService:
         """Cut the tenant's buffered pages into a new pending epoch.
         Returns the epoch id, or None when there is nothing buffered
         or the pending queue is full under reject-newest (the pages
-        stay buffered for a later cut)."""
+        stay buffered for a later cut).  With the ingest front armed
+        the cut flushes the queue first, so every upload submitted
+        before the cut is in or ahead of this epoch — never lost in
+        the queue."""
         t = self.tenants[tenant]
-        if t.open_page.count:
-            self._seal_open_page(t)
-        if not t.sealed:
-            return None
-        if len(t.pending) >= self.config.max_pending_epochs:
-            if self._shed(t) is None:
-                # reject-newest: the cut is refused (pages stay
-                # buffered for a later attempt), counted, not silent.
-                t.counters.inc("epochs_refused")
+        self.flush_ingest()
+        with t.lock:
+            to_seal: Optional[ReportPage] = None
+            if t.open_page.count:
+                to_seal = t.open_page
+                t.open_page = ReportPage()
+        if to_seal is not None:
+            t.seal_page(to_seal, self.injector)
+        with t.lock:
+            if not t.sealed:
                 return None
-        epoch = _Epoch(t.epoch_seq, t.sealed)
-        t.epoch_seq += 1
-        t.sealed = []
-        t.pending.append(epoch)
-        return epoch.epoch_id
+            if len(t.pending) >= self.config.max_pending_epochs:
+                if t.shed_oldest() is None:
+                    # reject-newest: the cut is refused (pages stay
+                    # buffered for a later attempt), counted, not
+                    # silent.
+                    t.counters.inc("epochs_refused")
+                    return None
+            epoch = _Epoch(t.epoch_seq, t.sealed)
+            t.epoch_seq += 1
+            t.sealed = []
+            t.pending.append(epoch)
+            return epoch.epoch_id
 
     def _build_run(self, t: _Tenant, reports: list) -> CollectionRun:
         spec = t.spec
@@ -676,11 +946,15 @@ class CollectorService:
                 # Admission already validated the blob; decode again
                 # so the run consumes exactly the persisted bytes.
                 reports.append(decode_upload(t.mastic, blob))
-        epoch.pages = surviving
+        with t.lock:
+            # The page list feeds report_count(), which ingest
+            # workers read through the admission quota.
+            epoch.pages = surviving
         return reports
 
     def _start_epoch(self, t: _Tenant) -> None:
-        epoch = t.pending.pop(0)
+        with t.lock:
+            epoch = t.pending.pop(0)
         self._checkpoint("epoch_start")
         epoch.span = obs_trace.get_tracer().start_detached_span(
             "epoch", tenant=t.spec.name, epoch=epoch.epoch_id,
@@ -709,9 +983,10 @@ class CollectorService:
                 t, epoch, result=[], truncated=True, levels=0,
                 error=f"{type(exc).__name__}: {exc}"))
             return
-        epoch.deadline = Deadline(self._epoch_deadline(t))
+        epoch.deadline = Deadline(t.eff_epoch_deadline)
         epoch.started_at = time.monotonic()
-        t.active = epoch
+        with t.lock:
+            t.active = epoch
 
     def _record(self, t: _Tenant, epoch: _Epoch, result,
                 truncated: bool, levels: int,
@@ -725,6 +1000,25 @@ class CollectorService:
             "truncated": truncated,
             "levels_completed": levels,
         }
+        if epoch.run is not None and epoch.run.metrics:
+            # Compile accounting over the epoch's rounds (this
+            # process's): the zero-steady-state-compile claim is
+            # checkable per epoch record, not just per live run —
+            # bench.py --service-overlap asserts it.
+            inline = 0
+            compile_ms = 0.0
+            for mx in epoch.run.metrics:
+                art = mx.extra.get("artifacts") or {}
+                inline += int(art.get("inline_compiles", 0))
+                pipe = mx.extra.get("pipeline") or {}
+                compile_ms += float(pipe.get("compile_inline_ms",
+                                             0.0))
+                for chunk in mx.extra.get("chunks") or ():
+                    compile_ms += float(
+                        chunk.get("phases", {}).get("compile_ms",
+                                                    0.0))
+            rec["inline_compiles"] = inline
+            rec["compile_ms"] = round(compile_ms, 2)
         if epoch.started_at is not None:
             rec["wall_s"] = round(time.monotonic() - epoch.started_at,
                                   3)
@@ -742,10 +1036,18 @@ class CollectorService:
     # -- the scheduler ---------------------------------------------
 
     def step(self) -> bool:
-        """One scheduler quantum: pick the next tenant (round-robin)
-        with work, run one round of its active epoch (starting the
-        oldest pending epoch if none is active), and return whether
-        any tenant still has epoch work queued or running."""
+        """One scheduler quantum.  Serial (overlap < 2): pick the
+        next tenant (round-robin) with work, run one round of its
+        active epoch (starting the oldest pending epoch if none is
+        active).  Overlapped (overlap = K >= 2): keep up to K
+        tenants' rounds in flight — stage rounds into the in-flight
+        window round-robin, then collect the oldest staged round's
+        blocking sync, so tenant B's host-side stage (page decode,
+        upload prep, AOT program fetch, dispatch) runs while tenant
+        A's dispatched round computes on device.  Returns whether any
+        tenant still has epoch work queued, running, or in flight."""
+        if self.config.overlap >= 2:
+            return self._step_overlapped()
         names = list(self.tenants)
         for off in range(len(names)):
             t = self.tenants[names[(self._rr + off) % len(names)]]
@@ -756,26 +1058,118 @@ class CollectorService:
             self._rr = (self._rr + off + 1) % len(names)
             self._run_one_round(t)
             break
+        self._publish_sched_gauges()
         return any(t.active is not None or t.pending
                    for t in self.tenants.values())
+
+    def _step_overlapped(self) -> bool:
+        """One overlapped quantum: fill the in-flight window (at most
+        one staged round per tenant — a tenant's rounds never overlap
+        each other, which is what keeps its results bit-identical to
+        the serial path), then collect the OLDEST in-flight round.
+        Atomic run kinds (no split seam) execute whole during their
+        stage slot; the device still computes another tenant's staged
+        round underneath them."""
+        names = list(self.tenants)
+        staged = {name for (name, _e) in self._inflight}
+        for off in range(len(names)):
+            if len(self._inflight) >= self.config.overlap:
+                break
+            name = names[(self._rr + off) % len(names)]
+            if name in staged:
+                continue
+            t = self.tenants[name]
+            if t.active is None and t.pending:
+                self._start_epoch(t)
+            if t.active is None:
+                continue
+            entry = self._stage_quantum(t)
+            if entry is not None:
+                self._inflight.append((name, entry))
+                staged.add(name)
+        if len(names):
+            self._rr = (self._rr + 1) % len(names)
+        if self._inflight:
+            (name, entry) = self._inflight.pop(0)
+            t = self.tenants[name]
+            entry["gap_ms"] = (time.perf_counter()
+                               - entry["staged_at"]) * 1e3
+            self._collect_quantum(t, entry)
+        self._publish_sched_gauges()
+        return bool(self._inflight) \
+            or any(t.active is not None or t.pending
+                   for t in self.tenants.values())
+
+    def _stage_quantum(self, t: _Tenant) -> Optional[dict]:
+        """Stage one round of the tenant's active epoch: deadline
+        gate, then `step_begin` under the epoch span.  Returns the
+        in-flight entry, or None when the quantum resolved inline
+        (deadline truncation, atomic round, epoch completion, or a
+        supervised failure)."""
+        epoch = t.active
+        self._checkpoint("epoch_round")
+        tracer = obs_trace.get_tracer()
+        if epoch.deadline.expired():
+            self._truncate_epoch(t, epoch)
+            return None
+        t0 = time.perf_counter()
+        before = len(epoch.run.metrics)
+        begin = getattr(epoch.run, "step_begin", None)
+        try:
+            with tracer.use_parent(epoch.span):
+                if begin is None:
+                    # Legacy / stub run kind: no split seam — run the
+                    # whole round as one atomic quantum.
+                    more = epoch.run.step()
+                    self._after_round(t, epoch, before, t0, more)
+                    self._sched_busy((time.perf_counter() - t0) * 1e3)
+                    return None
+                handle = begin()
+        except Exception as exc:   # supervised: fail the epoch, not
+            # the service — other tenants keep their schedule
+            self._round_failed(t, epoch, exc)
+            return None
+        stage_ms = (time.perf_counter() - t0) * 1e3
+        self._sched_busy(stage_ms)
+        if handle is None:
+            # The run had no round left (a resumed, already-final
+            # run): the epoch completes without touching the device.
+            self._complete_epoch(t, epoch)
+            return None
+        entry = {"handle": handle, "t0": t0, "before": before,
+                 "staged_at": time.perf_counter(), "gap_ms": 0.0}
+        if handle.get("atomic"):
+            # The whole round already ran inside begin (chunked runs
+            # own their sync discipline): finish it now — deferring
+            # would only delay the frontier advance.
+            self._collect_quantum(t, entry)
+            return None
+        return entry
+
+    def _collect_quantum(self, t: _Tenant, entry: dict) -> None:
+        """Collect one staged round: `step_finish` (the round's one
+        blocking sync) under the epoch span, then the shared
+        post-round bookkeeping."""
+        epoch = t.active
+        tracer = obs_trace.get_tracer()
+        t0 = time.perf_counter()
+        try:
+            with tracer.use_parent(epoch.span):
+                more = epoch.run.step_finish(entry["handle"])
+        except Exception as exc:
+            self._round_failed(t, epoch, exc)
+            return
+        collect_ms = (time.perf_counter() - t0) * 1e3
+        self._sched_busy(collect_ms + entry["gap_ms"])
+        self._after_round(t, epoch, entry["before"], entry["t0"],
+                          more)
 
     def _run_one_round(self, t: _Tenant) -> None:
         epoch = t.active
         self._checkpoint("epoch_round")
         tracer = obs_trace.get_tracer()
         if epoch.deadline.expired():
-            # Graceful degradation: finish at the last completed
-            # level; the frontier is correct for every round that ran.
-            t.counters.inc("deadline_misses")
-            t.counters.inc("epochs_truncated")
-            if epoch.span is not None:
-                epoch.span.event("deadline_miss",
-                                 levels=epoch.run.rounds_completed())
-            t.completed.append(self._record(
-                t, epoch, result=epoch.run.frontier(),
-                truncated=True,
-                levels=epoch.run.rounds_completed()))
-            t.active = None
+            self._truncate_epoch(t, epoch)
             return
         t0 = time.perf_counter()
         before = len(epoch.run.metrics)
@@ -787,31 +1181,60 @@ class CollectorService:
                 more = epoch.run.step()
         except Exception as exc:   # supervised: fail the epoch, not
             # the service — other tenants keep their schedule
-            epoch.failures += 1
-            if epoch.failures > self.config.epoch_retries:
-                t.counters.inc("epochs_failed")
-                t.completed.append(self._record(
-                    t, epoch, result=epoch.run.frontier(),
-                    truncated=True,
-                    levels=epoch.run.rounds_completed(),
-                    error=f"{type(exc).__name__}: {exc}"))
-                t.active = None
-            else:
-                # A round that raises mid-execution can leave the
-                # runner's device carries inconsistent, so the retry
-                # REBUILDS the run from the epoch's pages — prep is a
-                # pure function of the reports, so the restart is
-                # bit-identical (completed levels recompute; the r8
-                # respawn-and-replay model applied in-process).
-                if epoch.span is not None:
-                    epoch.span.event(
-                        "epoch_retry", attempt=epoch.failures,
-                        cause=f"{type(exc).__name__}: {exc}"[:200])
-                get_registry().counter(
-                    "mastic_session_retries_total",
-                    tenant=t.spec.name).inc()
-                epoch.run = self._build_run(t, epoch.reports)
+            self._round_failed(t, epoch, exc)
             return
+        self._after_round(t, epoch, before, t0, more)
+
+    def _truncate_epoch(self, t: _Tenant, epoch: _Epoch) -> None:
+        """Graceful degradation: finish at the last completed level;
+        the frontier is correct for every round that ran."""
+        t.counters.inc("deadline_misses")
+        t.counters.inc("epochs_truncated")
+        if epoch.span is not None:
+            epoch.span.event("deadline_miss",
+                             levels=epoch.run.rounds_completed())
+        t.completed.append(self._record(
+            t, epoch, result=epoch.run.frontier(),
+            truncated=True,
+            levels=epoch.run.rounds_completed()))
+        with t.lock:
+            t.active = None
+
+    def _round_failed(self, t: _Tenant, epoch: _Epoch,
+                      exc: Exception) -> None:
+        """Supervision: count the failure; past the retry budget the
+        epoch fails with its truncated frontier, otherwise the run is
+        REBUILT from the epoch's pages — a round that raises
+        mid-execution (staged or collected) can leave the runner's
+        device carries inconsistent, and prep is a pure function of
+        the reports, so the restart is bit-identical (completed
+        levels recompute; the r8 respawn-and-replay model applied
+        in-process)."""
+        epoch.failures += 1
+        if epoch.failures > self.config.epoch_retries:
+            t.counters.inc("epochs_failed")
+            t.completed.append(self._record(
+                t, epoch, result=epoch.run.frontier(),
+                truncated=True,
+                levels=epoch.run.rounds_completed(),
+                error=f"{type(exc).__name__}: {exc}"))
+            with t.lock:
+                t.active = None
+        else:
+            if epoch.span is not None:
+                epoch.span.event(
+                    "epoch_retry", attempt=epoch.failures,
+                    cause=f"{type(exc).__name__}: {exc}"[:200])
+            get_registry().counter(
+                "mastic_session_retries_total",
+                tenant=t.spec.name).inc()
+            epoch.run = self._build_run(t, epoch.reports)
+
+    def _after_round(self, t: _Tenant, epoch: _Epoch, before: int,
+                     t0: float, more: bool) -> None:
+        """Shared post-round bookkeeping for the serial and
+        overlapped paths: counters, the per-round service block,
+        occupancy gauges, epoch completion."""
         t.counters.inc("rounds")
         quantum_ms = (time.perf_counter() - t0) * 1e3
         reg = get_registry()
@@ -824,6 +1247,9 @@ class CollectorService:
                 "sched_overhead_ms": sched_ms,
                 "buffered_reports": t.buffered_reports(),
                 "pending_epochs": len(t.pending),
+                # Overlap context: staged rounds in flight when this
+                # round retired (0 = the serial r11 schedule).
+                "overlap_inflight": len(self._inflight),
             }
             # The service block joins the unified extra schema
             # (re-stamp: the driver already validated its own blocks).
@@ -837,11 +1263,49 @@ class CollectorService:
         reg.gauge("mastic_pending_epochs",
                   tenant=t.spec.name).set(len(t.pending))
         if not more:
-            t.counters.inc("epochs_completed")
-            t.completed.append(self._record(
-                t, epoch, result=epoch.run.result(), truncated=False,
-                levels=epoch.run.rounds_completed()))
+            self._complete_epoch(t, epoch)
+
+    def _complete_epoch(self, t: _Tenant, epoch: _Epoch) -> None:
+        t.counters.inc("epochs_completed")
+        t.completed.append(self._record(
+            t, epoch, result=epoch.run.result(), truncated=False,
+            levels=epoch.run.rounds_completed()))
+        with t.lock:
             t.active = None
+
+    # -- overlap accounting (occupancy + efficiency series) --------
+
+    def _sched_busy(self, ms: float) -> None:
+        """Accumulate scheduler busy time (stage work, collect work,
+        and in-flight device windows) into the current overlap
+        window.  Windows open at the first staged work and close when
+        the scheduler drains; busy > wall means staged device time
+        was hidden under other tenants' work."""
+        w = self._sched_window
+        if w is None:
+            w = self._sched_window = {"t0": time.perf_counter(),
+                                      "busy_ms": 0.0}
+        w["busy_ms"] += ms
+
+    def _publish_sched_gauges(self) -> None:
+        reg = get_registry()
+        occupancy = len(self._inflight)
+        reg.gauge("mastic_scheduler_occupancy").set(occupancy)
+        if self._ingest is not None:
+            reg.gauge("mastic_ingest_queue_depth").set(
+                self._ingest.queue.qsize())
+        if self._sched_window is not None and not self._inflight \
+                and not any(t.active is not None or t.pending
+                            for t in self.tenants.values()):
+            # Window closed: stamp the structural overlap efficiency
+            # (pipeline.overlap_efficiency semantics — 0.0 when
+            # nothing overlapped, the hidden fraction otherwise).
+            w = self._sched_window
+            wall_ms = (time.perf_counter() - w["t0"]) * 1e3
+            eff = overlap_efficiency(
+                [{"phases": {"busy_ms": w["busy_ms"]}}], wall_ms)
+            reg.gauge("mastic_sched_overlap_efficiency").set(eff)
+            self._sched_window = None
 
     def run_until_drained(self,
                           deadline: Optional[Deadline] = None) -> bool:
@@ -854,8 +1318,23 @@ class CollectorService:
         return True
 
     def drained(self) -> bool:
-        return not any(t.active is not None or t.pending
-                       for t in self.tenants.values())
+        return not self._inflight \
+            and not any(t.active is not None or t.pending
+                        for t in self.tenants.values())
+
+    def _drain_inflight(self) -> None:
+        """Collect every staged round (oldest first) so the service
+        reaches a quiescent point — the snapshot precondition: a
+        half-staged round serializes neither consistently nor
+        portably, so `to_bytes` retires them first (the same rounds
+        would recompute bit-identically after a crash anyway)."""
+        pending = list(self._inflight)
+        self._inflight = []
+        for (name, entry) in pending:
+            t = self.tenants[name]
+            entry["gap_ms"] = (time.perf_counter()
+                               - entry["staged_at"]) * 1e3
+            self._collect_quantum(t, entry)
 
     # -- observability ---------------------------------------------
 
@@ -863,7 +1342,11 @@ class CollectorService:
         """The service metrics JSON: per-tenant counters, buffer
         occupancy, quarantine/shed reason tables, epoch records."""
         out = {"policy": self.config.shed_policy,
-               "resumed": self.resumed, "tenants": {}}
+               "resumed": self.resumed,
+               "overlap": self.config.overlap,
+               "ingest_threads": self.config.ingest_threads,
+               "inflight_rounds": len(self._inflight),
+               "tenants": {}}
         for (name, t) in self.tenants.items():
             out["tenants"][name] = {
                 "buffered_reports": t.buffered_reports(),
@@ -888,9 +1371,19 @@ class CollectorService:
         (open + sealed), queued epochs, the active epoch's pages and
         its run checkpoint, completed results, and counters — the r8
         snapshot format (length-prefixed JSON binding header + npz
-        payload), extended to the ingest layer."""
+        payload), extended to the ingest layer.  The snapshot is a
+        quiescent point (ISSUE 10): the ingest queue flushes first
+        (every upload submitted before the snapshot fully lands),
+        in-flight overlapped rounds collect (a half-staged round's
+        device futures serialize neither consistently nor portably —
+        and would recompute bit-identically after a crash anyway),
+        and each tenant's buffers then serialize under its admission
+        lock so a concurrent submit can never tear a page across the
+        npz arrays."""
         import io
 
+        self.flush_ingest()
+        self._drain_inflight()
         self._checkpoint("snapshot")
         header = json.dumps({
             "version": _SNAPSHOT_VERSION,
@@ -920,22 +1413,25 @@ class CollectorService:
                 put_page(f"{prefix}_pg{j}", page)
 
         for (i, t) in enumerate(self.tenants.values()):
-            data[f"t{i}_state"] = np.array(
-                [t.epoch_seq, int(t.suspended), len(t.sealed),
-                 len(t.pending), int(t.active is not None)], np.int64)
-            data[f"t{i}_counters"] = np.frombuffer(
-                json.dumps(t.counters.as_dict()).encode(), np.uint8)
-            data[f"t{i}_completed"] = np.frombuffer(
-                json.dumps(t.completed).encode(), np.uint8)
-            put_page(f"t{i}_open", t.open_page)
-            for (j, page) in enumerate(t.sealed):
-                put_page(f"t{i}_s{j}", page)
-            for (k, epoch) in enumerate(t.pending):
-                put_epoch(f"t{i}_p{k}", epoch)
-            if t.active is not None:
-                put_epoch(f"t{i}_active", t.active)
-                data[f"t{i}_active_run"] = np.frombuffer(
-                    t.active.run.to_bytes(), np.uint8)
+            with t.lock:
+                data[f"t{i}_state"] = np.array(
+                    [t.epoch_seq, int(t.suspended), len(t.sealed),
+                     len(t.pending), int(t.active is not None)],
+                    np.int64)
+                data[f"t{i}_counters"] = np.frombuffer(
+                    json.dumps(t.counters.as_dict()).encode(),
+                    np.uint8)
+                data[f"t{i}_completed"] = np.frombuffer(
+                    json.dumps(t.completed).encode(), np.uint8)
+                put_page(f"t{i}_open", t.open_page)
+                for (j, page) in enumerate(t.sealed):
+                    put_page(f"t{i}_s{j}", page)
+                for (k, epoch) in enumerate(t.pending):
+                    put_epoch(f"t{i}_p{k}", epoch)
+                if t.active is not None:
+                    put_epoch(f"t{i}_active", t.active)
+                    data[f"t{i}_active_run"] = np.frombuffer(
+                        t.active.run.to_bytes(), np.uint8)
         buf = io.BytesIO()
         np.savez(buf, **data)
         return (len(header).to_bytes(4, "little") + header
@@ -998,28 +1494,33 @@ class CollectorService:
         for (i, t) in enumerate(svc.tenants.values()):
             (seq, susp, nsealed, npending, has_active) = [
                 int(x) for x in arrays[f"t{i}_state"]]
-            t.epoch_seq = seq
-            t.suspended = bool(susp)
-            restored = json.loads(arrays[f"t{i}_counters"].tobytes())
-            # Pre-ISSUE-7 snapshots carry no tenant label.
-            restored.setdefault("tenant", t.spec.name)
-            t.counters = ServiceCounters.from_dict(restored)
-            t.counters.resumes += 1
+            # Under the admission lock: a restored service's ingest
+            # front is already live, so the buffer swap must be
+            # atomic against a concurrent submit.
+            with t.lock:
+                t.epoch_seq = seq
+                t.suspended = bool(susp)
+                restored = json.loads(
+                    arrays[f"t{i}_counters"].tobytes())
+                # Pre-ISSUE-7 snapshots carry no tenant label.
+                restored.setdefault("tenant", t.spec.name)
+                t.counters = ServiceCounters.from_dict(restored)
+                t.counters.resumes += 1
+                t.completed = json.loads(
+                    arrays[f"t{i}_completed"].tobytes())
+                t.open_page = get_page(f"t{i}_open")
+                t.sealed = [get_page(f"t{i}_s{j}")
+                            for j in range(nsealed)]
+                t.pending = [get_epoch(f"t{i}_p{k}")
+                             for k in range(npending)]
             # Republish the persisted totals so the Prometheus series
             # continue where the crashed process left them.
             t.counters.export_registry()
-            t.completed = json.loads(
-                arrays[f"t{i}_completed"].tobytes())
-            t.open_page = get_page(f"t{i}_open")
-            t.sealed = [get_page(f"t{i}_s{j}")
-                        for j in range(nsealed)]
-            t.pending = [get_epoch(f"t{i}_p{k}")
-                         for k in range(npending)]
             if has_active:
                 epoch = get_epoch(f"t{i}_active")
                 reports = svc._epoch_reports(t, epoch)
                 if not reports:
-                    t.counters.epochs_failed += 1
+                    t.counters.inc("epochs_failed")
                     t.completed.append(svc._record(
                         t, epoch, result=[], truncated=True,
                         levels=0, error="no surviving reports after "
@@ -1029,7 +1530,7 @@ class CollectorService:
                     epoch.run = svc._restore_run(
                         t, reports, arrays[f"t{i}_active_run"]
                         .tobytes())
-                    epoch.deadline = Deadline(svc._epoch_deadline(t))
+                    epoch.deadline = Deadline(t.eff_epoch_deadline)
                     epoch.started_at = time.monotonic()
                     epoch.span = obs_trace.get_tracer() \
                         .start_detached_span(
@@ -1037,7 +1538,8 @@ class CollectorService:
                             epoch=epoch.epoch_id,
                             reports=epoch.report_count(),
                             resumed=True)
-                    t.active = epoch
+                    with t.lock:
+                        t.active = epoch
         return svc
 
 

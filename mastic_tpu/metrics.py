@@ -33,6 +33,7 @@ metrics registry (tenant-labelled Prometheus series), and
 (`obs/schema.py`).
 """
 
+import threading
 from dataclasses import asdict, dataclass, field
 
 import numpy as np
@@ -118,7 +119,14 @@ class ServiceCounters:
     dataclass remains the snapshot/serialization ledger.
     `export_registry()` republishes the persisted totals after a
     snapshot restore so a resumed service's series continue from
-    where the crashed process left them."""
+    where the crashed process left them.
+
+    ISSUE 10: the concurrent ingest front increments these from its
+    worker threads while the scheduler thread increments and
+    snapshots them, so every mutation (and `as_dict`, which iterates
+    the reason dicts) runs under the ledger's own lock.  The registry
+    mirror calls stay OUTSIDE the lock — the registry locks itself,
+    and nesting the two would couple their lock orders for nothing."""
 
     tenant: str = ""             # registry label; "" = unattributed
     admitted: int = 0
@@ -137,10 +145,16 @@ class ServiceCounters:
     quarantine_reasons: dict = field(default_factory=dict)
     shed_reasons: dict = field(default_factory=dict)
 
+    def __post_init__(self):
+        # Not a dataclass field: the lock never serializes (asdict
+        # walks fields only) and a restored ledger builds a fresh one.
+        self._lock = threading.Lock()
+
     def inc(self, name: str, n: int = 1) -> None:
         """Increment one counter field, mirroring into the registry
         when the field has a Prometheus twin (_SERVICE_SERIES)."""
-        setattr(self, name, getattr(self, name) + n)
+        with self._lock:
+            setattr(self, name, getattr(self, name) + n)
         series = _SERVICE_SERIES.get(name)
         if series is not None:
             (metric, outcome) = series
@@ -150,15 +164,17 @@ class ServiceCounters:
             get_registry().counter(metric, **labels).inc(n)
 
     def bump_quarantine(self, reason: str, n: int = 1) -> None:
-        self.quarantine_reasons[reason] = \
-            self.quarantine_reasons.get(reason, 0) + n
+        with self._lock:
+            self.quarantine_reasons[reason] = \
+                self.quarantine_reasons.get(reason, 0) + n
         get_registry().counter("mastic_reports_quarantined_total",
                                tenant=self.tenant,
                                reason=reason).inc(n)
 
     def bump_shed(self, reason: str, n: int = 1) -> None:
-        self.shed_reasons[reason] = \
-            self.shed_reasons.get(reason, 0) + n
+        with self._lock:
+            self.shed_reasons[reason] = \
+                self.shed_reasons.get(reason, 0) + n
         get_registry().counter("mastic_reports_shed_total",
                                tenant=self.tenant,
                                reason=reason).inc(n)
@@ -194,7 +210,12 @@ class ServiceCounters:
                   tenant=self.tenant).set(0)
 
     def as_dict(self) -> dict:
-        return asdict(self)
+        # Under the lock: asdict deep-copies the reason dicts, and an
+        # ingest worker bumping one mid-iteration would otherwise
+        # tear the snapshot (RuntimeError at best, torn ledger at
+        # worst).
+        with self._lock:
+            return asdict(self)
 
     @classmethod
     def from_dict(cls, data: dict) -> "ServiceCounters":

@@ -106,6 +106,16 @@ DECLARED = {
         ("counter", "AOT artifact-store load attempts, by gate "
          "outcome (hit/miss/probe_fail/version_skew/corrupt)",
          ("outcome",)),
+    "mastic_scheduler_occupancy":
+        ("gauge", "staged tenant rounds in flight at the end of the "
+         "last scheduler quantum (0 = serial round-robin)", ()),
+    "mastic_sched_overlap_efficiency":
+        ("gauge", "structural overlap of the last drained scheduler "
+         "window: fraction of staged round time hidden behind other "
+         "tenants' work (pipeline.overlap_efficiency semantics)", ()),
+    "mastic_ingest_queue_depth":
+        ("gauge", "uploads waiting in the concurrent ingest front's "
+         "bounded queue", ()),
 }
 
 

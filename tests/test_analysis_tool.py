@@ -154,6 +154,36 @@ def test_shipped_tree_is_clean():
         "the interprocedural secret-flow register is empty")
 
 
+def test_ingest_worker_is_a_discovered_thread_root():
+    """ISSUE 10 regression: the concurrency pass only proves the
+    ingest front's lock discipline if the call-graph actually
+    discovers `_IngestFront._worker` as a thread root and walks the
+    admission path from it — a silently-undiscovered root would make
+    the CC001-clean verdict vacuous."""
+    import pathlib
+
+    from tools.analysis import callgraph, load_paths
+
+    repo = pathlib.Path(__file__).parent.parent
+    files = sorted((repo / "mastic_tpu").rglob("*.py"))
+    files.append(repo / "tools" / "serve.py")
+    (infos, parse_findings) = load_paths(files)
+    assert parse_findings == []
+    program = callgraph.Program(infos)
+    roots = [r.qual for roots in program.thread_roots.values()
+             for r in roots]
+    workers = [q for q in roots if "_IngestFront._worker" in q]
+    assert workers, f"ingest worker not a thread root: {roots}"
+    # The admission path is reachable from that root AND from the
+    # main entry group — exactly the cross-thread shape CC001 audits.
+    admit = next(fn for fn in program.functions.values()
+                 if fn.qual.endswith("_Tenant.admit_decoded"))
+    groups = program.root_groups(admit)
+    assert any("_worker" in g for g in groups), groups
+    assert len(groups) >= 2, (
+        f"admit_decoded must span thread roots, got {groups}")
+
+
 def test_suppression_budget_within_baseline():
     """The committed allow_budget.json covers the shipped tree, and
     the gate actually trips when the budget shrinks below reality."""

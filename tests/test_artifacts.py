@@ -375,6 +375,60 @@ def test_round_trip_bit_identity_full_rounds(tmp_path, monkeypatch,
         assert mx.extra["artifacts"]["store"] == store.path
 
 
+@pytest.mark.slow
+def test_attribute_round_rides_artifact_tier(tmp_path, monkeypatch):
+    """ISSUE 10 satellite: the attribute-metrics round program (a
+    bare per-(ctx, agg_param) jit before r15) rides the AOT tier —
+    baked via artifacts.bake_attribute_round, loaded through all
+    three gates, zero inline compiles and a bit-identical aggregate
+    on the warm path."""
+    from mastic_tpu.backend.mastic_jax import BatchedMastic
+    from mastic_tpu.drivers.attribute_metrics import \
+        aggregate_by_attribute
+    from mastic_tpu.drivers.heavy_hitters import \
+        get_reports_from_measurements
+    from mastic_tpu.mastic import MasticCount
+
+    monkeypatch.delenv("MASTIC_ARTIFACT_DIR", raising=False)
+    m = MasticCount(4)   # small tree keeps the from-root compile cheap
+    ctx = b"attr artifact"
+    attrs = ["checkout.html", "landing.html"]  # distinct 4-bit hashes
+    from mastic_tpu.drivers.attribute_metrics import hash_attribute
+
+    alpha = hash_attribute(m, attrs[0])
+    val = int("".join("1" if b else "0" for b in alpha), 2)
+    meas = [(m.vidpf.test_index_from_int(v, 4), True)
+            for v in (val, val, 0)]
+    reports = get_reports_from_measurements(m, ctx, meas)
+    vk = bytes(range(m.VERIFY_KEY_SIZE))
+    mx_ref: list = []
+    ref = aggregate_by_attribute(m, ctx, attrs, reports,
+                                 verify_key=vk, metrics_out=mx_ref)
+    assert mx_ref[0].extra["artifacts"]["inline_compiles"] > 0
+
+    store = artifacts.default_store(str(tmp_path / "attr"))
+    baker = artifacts.make_baker(BatchedMastic(m), ctx)
+    stats = artifacts.bake_attribute_round(
+        baker, store, len(reports), attrs, with_stablehlo=False)
+    assert stats["compiled"] == 1
+    # Re-baking is a skip, not a recompile.
+    assert artifacts.bake_attribute_round(
+        baker, store, len(reports), attrs,
+        with_stablehlo=False)["skipped"] == 1
+    # Drop the in-memory memo so the load comes from disk through
+    # the digest/runtime/probe gates.
+    artifacts._stores.pop(store.path, None)
+    monkeypatch.setenv("MASTIC_ARTIFACT_DIR", store.path)
+    mx_warm: list = []
+    warm = aggregate_by_attribute(m, ctx, attrs, reports,
+                                  verify_key=vk, metrics_out=mx_warm)
+    assert warm == ref
+    art = mx_warm[0].extra["artifacts"]
+    assert art["inline_compiles"] == 0, art
+    assert art["hits"] >= 1
+    assert art["store"] == store.path
+
+
 def test_save_refuses_donating_executable():
     """The memory-safety guard behind the donation-free bake rule: a
     deserialized executable with input-output aliasing double-frees

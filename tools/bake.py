@@ -98,6 +98,22 @@ def bake(args) -> dict:
                 totals[key] += v
             print(f"[bake] rows={rows} grow<={args.grow_frontier}: "
                   f"{stats}", file=sys.stderr, flush=True)
+        if args.attributes:
+            # The attribute-metrics round program (ISSUE 10: the
+            # from-root round now rides the artifact tier too) —
+            # baked per (attribute set, rows, mesh shape), preloaded
+            # by the service at tenant admission like every other
+            # family member.
+            baker = artifacts.make_baker(bm, ctx, width=args.width,
+                                         mesh=mesh)
+            stats = artifacts.bake_attribute_round(
+                baker, store, rows, args.attributes,
+                with_stablehlo=not args.no_stablehlo)
+            for (key, v) in stats.items():
+                totals[key] += v
+            print(f"[bake] rows={rows} attributes="
+                  f"{','.join(args.attributes)}: {stats}",
+                  file=sys.stderr, flush=True)
     return {
         "mode": "bake",
         "out": store.path,
@@ -106,6 +122,7 @@ def bake(args) -> dict:
         "ctx": args.ctx,
         "rows": args.rows,
         "hitters": args.hitters,
+        "attributes": args.attributes,
         "mesh_devices": args.mesh or 1,
         "entries": store.entry_count(),
         "store_bytes": store.store_bytes(),
@@ -151,7 +168,8 @@ def smoke(args) -> dict:
     bake_args = argparse.Namespace(
         out=tmp, spec=None, bits=cfg["bits"], ctx=cfg["ctx"],
         rows=[cfg["chunk"]], hitters=[cfg["hitters"]],
-        grow_frontier=0, width=8, mesh=0, no_stablehlo=False)
+        grow_frontier=0, attributes=[], width=8, mesh=0,
+        no_stablehlo=False)
     rec = bake(bake_args)
     print(f"[smoke] baked {rec['entries']} entries in "
           f"{rec['wall_seconds']}s", file=sys.stderr, flush=True)
@@ -225,6 +243,12 @@ def main() -> None:
                         help="also bake the all-survive growth "
                              "trajectory up to this frontier width "
                              "(covers padded-width growth programs)")
+    parser.add_argument("--attributes", type=str, default="",
+                        help="comma-separated attribute list: also "
+                             "bake the attribute-metrics round "
+                             "program for it (must match the serving "
+                             "config's list exactly — the hashed "
+                             "prefixes are baked into the program)")
     parser.add_argument("--width", type=int, default=8,
                         help="initial padded node width (grown on "
                              "demand, as at runtime)")
@@ -243,6 +267,8 @@ def main() -> None:
     args = parser.parse_args()
     args.rows = [int(x) for x in str(args.rows).split(",") if x]
     args.hitters = [int(x) for x in str(args.hitters).split(",") if x]
+    args.attributes = [x for x in str(args.attributes).split(",")
+                       if x]
 
     if args.mesh:
         flags = os.environ.get("XLA_FLAGS", "")

@@ -85,6 +85,18 @@ run mesh-all python bench.py --chunked-round-only --mesh all
 # (scheduler-overhead numbers for PERF.md).
 run serve-soak python tools/serve.py --soak 120 --bits 4 --reports 32
 
+# 6d. Overlapped multi-tenant epoch execution on the chip (ISSUE 10):
+# the round-robin-vs-overlap throughput comparison where it actually
+# means something — host-side stage/collect work hiding behind real
+# device dispatch.  The JSON line stamps baseline_reports_per_sec /
+# overlap_reports_per_sec / speedup with bit-identity and the
+# zero-steady-state-compile assertion (PERF.md §12); the soak twin
+# runs the live service with the overlapped executor + ingest front
+# armed for two minutes.
+run serve-overlap python bench.py --service-overlap
+run serve-overlap-soak python tools/serve.py --soak 120 --bits 4 \
+    --reports 32 --overlap 2 --ingest-threads 2
+
 # 6c. On-chip AOT bake + trace-free load cycle (ISSUE 9,
 # drivers/artifacts.py): bake the cold-start family on the chip,
 # then bench.py --cold-start reuses the store (MASTIC_ARTIFACT_DIR

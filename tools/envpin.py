@@ -1,0 +1,32 @@
+"""Pre-import environment pinning for the serving entrypoints.
+
+`tools/serve.py` (and friends) translate argv flags into process
+environment *before* importing jax or spawning any thread — the
+ingest-front / status-server threads that exist later only ever READ
+the environment (e.g. the tracer's `MASTIC_TRACE_FILE` probe).  The
+writes live in this helper, outside the concurrency analyzer's
+service-plane scope, precisely because they are argv-time,
+single-threaded setup with a real happens-before edge (thread start)
+between them and every reader; keeping them in serve.py would force
+a lock (or an allow) around writes no thread can ever race.
+
+Anything that mutates os.environ AFTER threads exist must NOT use
+this module — set the lever before boot instead.
+"""
+
+import os
+
+
+def pin(name: str, value: str) -> None:
+    """argv-time `os.environ[name] = value` (see module docstring)."""
+    os.environ[name] = value
+
+
+def force_host_devices(n: int) -> None:
+    """Pin XLA's virtual host device count (must run before the jax
+    import snapshots XLA_FLAGS); a pre-existing setting wins."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count="
+            f"{n}").strip()

@@ -78,11 +78,13 @@ def build_reports(m, ctx, rng, values, bits):
 
 def strip_wall(records):
     """Epoch records minus wall-clock stamps (the bit-identity
-    comparison target: everything except timing)."""
+    comparison target: everything except timing — compile accounting
+    is timing too: a resumed run recomputes fewer rounds)."""
     out = []
     for rec in records:
         rec = dict(rec)
-        rec.pop("wall_s", None)
+        for key in ("wall_s", "compile_ms", "inline_compiles"):
+            rec.pop(key, None)
         out.append(rec)
     return out
 
@@ -114,7 +116,11 @@ def drain(svc, snapshot_path=None, deadline=None, status=None) -> None:
         # per-epoch deadlines bound each epoch; this bounds the loop).
         deadline = Deadline(3600.0)
     while svc.step():
-        if snapshot_path:
+        # Snapshots are quiescent points: with the overlapped
+        # executor armed, writing one mid-window would force-drain
+        # the in-flight rounds every quantum — snapshot only when
+        # nothing is staged (serial mode: every quantum, as before).
+        if snapshot_path and svc.inflight_rounds() == 0:
             write_snapshot(svc, snapshot_path)
         publish_status(status, svc)
         if deadline.expired():
@@ -206,6 +212,12 @@ def main() -> None:
     parser.add_argument("--resume", action="store_true")
     parser.add_argument("--smoke", action="store_true",
                         help="the serve-smoke robustness gate")
+    parser.add_argument("--overlap-drill", action="store_true",
+                        help="the overlapped-epoch drill: concurrent "
+                             "submit burst against the ingest front, "
+                             "then a kill-9 + --resume pair with the "
+                             "overlapped executor armed (part of "
+                             "`make serve-smoke`)")
     parser.add_argument("--soak", type=float, default=0.0,
                         help="unattended soak for SECONDS "
                              "(chip-session cell)")
@@ -213,6 +225,17 @@ def main() -> None:
                         help="serve /metrics, /statusz and /varz on "
                              "127.0.0.1:PORT (0 = ephemeral; USAGE.md "
                              "'Observability')")
+    parser.add_argument("--overlap", type=int, default=None,
+                        help="keep up to K tenants' rounds in flight "
+                             "(overlapped epoch executor; sets "
+                             "MASTIC_SERVICE_OVERLAP — <2 = the "
+                             "serial round-robin scheduler)")
+    parser.add_argument("--ingest-threads", type=int, default=None,
+                        help="decode-validate admissions on this "
+                             "many worker threads behind a bounded "
+                             "queue (concurrent ingest front; sets "
+                             "MASTIC_SERVICE_INGEST_THREADS — 0 = "
+                             "in-process admission)")
     parser.add_argument("--artifact-dir", type=str, default=None,
                         help="AOT artifact store (tools/bake.py) — "
                              "preloaded at startup and on tenant "
@@ -224,16 +247,21 @@ def main() -> None:
 
     if args.resume and not args.snapshot:
         parser.error("--resume needs --snapshot PATH")
+    # argv-time environment pinning (tools/envpin.py): these writes
+    # happen strictly before any thread or the jax import exists.
+    from tools import envpin
+
     if args.artifact_dir:
         # The env lever is the one seam every runner reads
         # (drivers/artifacts.store_from_env); the flag just sets it.
-        os.environ["MASTIC_ARTIFACT_DIR"] = args.artifact_dir
+        envpin.pin("MASTIC_ARTIFACT_DIR", args.artifact_dir)
+    if args.overlap is not None:
+        envpin.pin("MASTIC_SERVICE_OVERLAP", str(args.overlap))
+    if args.ingest_threads is not None:
+        envpin.pin("MASTIC_SERVICE_INGEST_THREADS",
+                   str(args.ingest_threads))
     if args.mesh:
-        flags = os.environ.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                f"{flags} --xla_force_host_platform_device_count="
-                f"{args.mesh}").strip()
+        envpin.force_host_devices(args.mesh)
 
     import numpy as np
     import jax
@@ -249,6 +277,9 @@ def main() -> None:
 
     if args.smoke:
         run_smoke(args, mesh, status=start_status(args.status_port))
+        return
+    if args.overlap_drill:
+        run_overlap_drill(args)
         return
 
     from mastic_tpu.drivers.service import (CollectorService,
@@ -334,6 +365,148 @@ def main() -> None:
         "results": {name: strip_wall(t["epochs"])
                     for (name, t) in metrics["tenants"].items()},
         "metrics": metrics,
+        "ok": True,
+    }
+    line = json.dumps(out)
+    print(line, flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+
+
+def run_overlap_drill(args) -> None:
+    """The overlapped-epoch drill (`make serve-smoke`, ISSUE 10):
+
+    1. concurrent submit burst — 4 client threads stream uploads into
+       2 tenants through a live ingest front (3 workers, a 16-deep
+       bounded queue): every submission must be accounted exactly
+       once (admitted + shed == submitted, the buffered pages hold
+       exactly the admitted blobs — zero lost, zero duplicated), and
+       the burst must never block on the scheduler;
+    2. kill-9 + --resume — the default two-tenant serve scenario runs
+       as child processes with `--overlap 2 --ingest-threads 2`: a
+       clean run, a run hard-killed mid-drain by the injector at the
+       scheduler's epoch_round checkpoint, and a `--resume` from the
+       killed run's snapshot, whose results must equal the clean
+       run's bit for bit.
+    """
+    import subprocess
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from mastic_tpu.drivers import faults
+    from mastic_tpu.drivers.service import (QUEUED, SHED,
+                                            CollectorService,
+                                            ServiceConfig, TenantSpec,
+                                            encode_upload)
+    from mastic_tpu.mastic import MasticCount
+
+    t_start = time.time()
+    bits = 2
+    m = MasticCount(bits)
+    rng = np.random.default_rng(args.seed)
+    vk = bytes(rng.integers(0, 256, m.VERIFY_KEY_SIZE, dtype="uint8"))
+    specs = [
+        TenantSpec(name=f"t{i}",
+                   spec={"class": "MasticCount", "args": [bits]},
+                   ctx=b"drill", verify_key=vk,
+                   thresholds={"default": 2}, max_buffered=64)
+        for i in range(2)
+    ]
+    cfg = ServiceConfig(page_size=4, max_buffered=64,
+                        shed_policy="reject-newest",
+                        overlap=2, ingest_threads=3, ingest_queue=16,
+                        epoch_deadline=600.0)
+    svc = CollectorService(specs, config=cfg)
+    per_thread = 10
+    blobs = [encode_upload(m, r)
+             for r in build_reports(m, b"drill", rng,
+                                    [0] * per_thread, bits)]
+    outcomes: list = []
+    mu = threading.Lock()
+
+    def feed(tenant: str) -> None:
+        got = [svc.submit(tenant, b) for b in blobs]
+        with mu:
+            outcomes.extend(got)
+
+    threads = [threading.Thread(target=feed, args=(f"t{i % 2}",))
+               for i in range(4)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    svc.flush_ingest()
+    queued = sum(1 for o in outcomes if o[0] == QUEUED)
+    shed_at_queue = sum(1 for o in outcomes
+                        if o == (SHED, "ingest-queue-full"))
+    if queued + shed_at_queue != 4 * per_thread:
+        fail(f"burst outcomes unaccounted: {queued} queued + "
+             f"{shed_at_queue} queue-shed != {4 * per_thread}")
+    mx = svc.metrics()["tenants"]
+    landed = 0
+    queue_shed_counted = 0
+    for name in ("t0", "t1"):
+        c = mx[name]["counters"]
+        qshed = c["shed_reasons"].get("ingest-queue-full", 0)
+        queue_shed_counted += qshed
+        landed += c["admitted"] + c["quarantined"] \
+            + (c["shed"] - qshed)
+        if c["admitted"] != mx[name]["buffered_reports"]:
+            fail(f"{name}: admitted {c['admitted']} != buffered "
+                 f"{mx[name]['buffered_reports']} (lost/dup pages)")
+    if queue_shed_counted != shed_at_queue:
+        fail(f"queue-full sheds miscounted: counters say "
+             f"{queue_shed_counted}, callers saw {shed_at_queue}")
+    if landed + shed_at_queue != 4 * per_thread:
+        fail(f"burst accounting: {landed} landed + {shed_at_queue} "
+             f"queue-shed != {4 * per_thread}")
+    svc.stop_ingest()
+
+    # 2. kill-9 + --resume with overlap + ingest armed, as children.
+    tmp = tempfile.mkdtemp(prefix="mastic_overlap_drill_")
+    me = os.path.abspath(__file__)
+
+    def run_child(extra, fault=None, expect_rc=0):
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        env.pop("MASTIC_FAULTS", None)
+        if fault is not None:
+            env["MASTIC_FAULTS"] = fault
+        # All three children run the parser-default seed: the drill
+        # needs them deterministic relative to EACH OTHER, nothing
+        # else (and argv stays free of anything seed-derived).
+        cmd = [sys.executable, me, "--reports", "4",
+               "--overlap", "2", "--ingest-threads", "2"] + extra
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=1800, env=env)
+        if proc.returncode != expect_rc:
+            fail(f"drill child {extra} rc={proc.returncode} "
+                 f"(wanted {expect_rc}): {proc.stderr[-1500:]}")
+        return proc
+
+    clean = run_child(["--snapshot", os.path.join(tmp, "clean.snap")])
+    clean_out = json.loads(clean.stdout.strip().splitlines()[-1])
+    snap = os.path.join(tmp, "killed.snap")
+    run_child(["--snapshot", snap],
+              fault="kill:party=collector:step=epoch_round:nth=2",
+              expect_rc=faults.KILL_EXIT_CODE)
+    if not os.path.exists(snap):
+        fail("killed child left no snapshot")
+    resumed = run_child(["--snapshot", snap, "--resume"])
+    resumed_out = json.loads(resumed.stdout.strip().splitlines()[-1])
+    if resumed_out["results"] != clean_out["results"]:
+        fail(f"overlap kill-9 resume diverged: "
+             f"{resumed_out['results']} != {clean_out['results']}")
+
+    out = {
+        "mode": "overlap-drill",
+        "burst_submitted": 4 * per_thread,
+        "burst_admitted": landed,
+        "burst_queue_shed": shed_at_queue,
+        "kill9_resume_bit_identical": True,
+        "wall_seconds": round(time.time() - t_start, 1),
         "ok": True,
     }
     line = json.dumps(out)
