@@ -33,6 +33,16 @@
 #                epoch drill (tools/serve.py --overlap-drill:
 #                concurrent submit burst through the ingest front +
 #                kill-9 + --resume with MASTIC_SERVICE_OVERLAP=2)
+#   make net-smoke  network-front gate (mastic_tpu/net/, ISSUE 11):
+#                fast tier of tests/test_net.py (DAP framing golden
+#                vectors, token-bucket/connection admission, network
+#                fault checkpoints, shaped transport, concurrent-
+#                upload page-multiset stress), the shaped
+#                leader/helper bit-identity acceptance test by
+#                explicit node id, and tools/loadgen.py --smoke
+#                (10^5 simulated clients against a local upload
+#                endpoint: SLO held, knee degradation by policy,
+#                per-IP rate limit, kill-9 mid-upload resume drill)
 #   make obs-smoke  telemetry-layer gate (mastic_tpu/obs/, ISSUE 7):
 #                tests/test_obs.py (spans, registry, schema, HTTP
 #                status surface, tracing-on/off bit-identity) plus a
@@ -64,11 +74,11 @@
 
 PY ?= python
 
-.PHONY: ci lint analyze faults serve-smoke obs-smoke pipeline \
-	artifacts-smoke multichip typecheck test-fast test test-slow \
-	test-slow-1 test-slow-2 test-slow-3 bench
+.PHONY: ci lint analyze faults serve-smoke net-smoke obs-smoke \
+	pipeline artifacts-smoke multichip typecheck test-fast test \
+	test-slow test-slow-1 test-slow-2 test-slow-3 bench
 
-ci: lint analyze faults serve-smoke obs-smoke pipeline \
+ci: lint analyze faults serve-smoke net-smoke obs-smoke pipeline \
 	artifacts-smoke multichip typecheck test-fast
 
 faults:
@@ -83,6 +93,15 @@ serve-smoke:
 	$(PY) -m pytest -q "tests/test_service.py::test_epoch_bit_identical_to_offline_with_mid_epoch_resume"
 	JAX_PLATFORMS=cpu $(PY) tools/serve.py --smoke
 	JAX_PLATFORMS=cpu $(PY) tools/serve.py --overlap-drill
+
+# The shaped-parties bit-identity test is slow-marked (two full
+# process-separated sessions pay real prep compiles) but runs HERE
+# by explicit node id — it is this gate's acceptance test, exactly
+# the serve-smoke pattern.
+net-smoke:
+	$(PY) -m pytest tests/test_net.py -q -m "not slow"
+	$(PY) -m pytest -q "tests/test_net.py::test_shaped_parties_bit_identical_to_in_process"
+	JAX_PLATFORMS=cpu $(PY) tools/loadgen.py --smoke
 
 # The status-port smoke reuses serve.py --smoke's scenario with the
 # HTTP surface armed: the run itself curls /metrics, /statusz and
@@ -126,6 +145,7 @@ test-fast:
 		--ignore=tests/test_faults.py \
 		--ignore=tests/test_service.py \
 		--ignore=tests/test_service_overlap.py \
+		--ignore=tests/test_net.py \
 		--ignore=tests/test_obs.py \
 		--ignore=tests/test_pipeline.py \
 		--ignore=tests/test_artifacts.py \

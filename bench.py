@@ -651,6 +651,161 @@ def bench_chunked_round(args) -> dict:
     }
 
 
+def bench_parties_wan(args) -> dict:
+    """The `--parties-wan` config (ISSUE 11): the process-separated
+    leader/helper session over the SHAPED network link
+    (`MASTIC_NET_SHAPE`, mastic_tpu/net/transport.py), extending
+    BASELINE's communication-only byte counts into a measured
+    communication-vs-computation crossover.
+
+    Method: one unshaped session is the compute baseline, then one
+    session per bandwidth/RTT cell of the ladder.  Every session
+    uploads the same seeded batch, pays one warm round (the parties'
+    per-round trace/compile — identical across cells), then measures
+    `--wan-rounds` rounds; the per-cell communication cost is the
+    wall delta against the unshaped baseline, so the (large, equal)
+    host/device work cancels.  Bit-identity across every cell is
+    ASSERTED — a shaped link may slow the round, never change the
+    aggregate.  The crossover stamp is the bandwidth at which the
+    round's wire bytes take as long as the unshaped round computes:
+    below it the session is communication-bound (the draft's
+    deployment question, measured)."""
+    import numpy as np
+
+    from mastic_tpu.drivers.parties import AggregationSession
+    from mastic_tpu.drivers.session import SessionConfig
+    from mastic_tpu.mastic import MasticCount
+    from mastic_tpu.metrics import RoundMetrics, count_round_bytes
+    from mastic_tpu.net.transport import parse_shape
+
+    bits = args.wan_bits
+    n = args.wan_reports
+    m = MasticCount(bits)
+    spec = {"class": "MasticCount", "args": [bits]}
+    ctx = b"bench parties wan"
+    vk = bytes(range(m.VERIFY_KEY_SIZE))
+    rng = np.random.default_rng(0)
+    reports = []
+    for i in range(n):
+        value = 0 if i % 2 == 0 else (1 << bits) - 1
+        alpha = m.vidpf.test_index_from_int(value, bits)
+        nonce = bytes(rng.integers(0, 256, m.NONCE_SIZE,
+                                   dtype="uint8"))
+        rand = bytes(rng.integers(0, 256, m.RAND_SIZE,
+                                  dtype="uint8"))
+        (ps, shares) = m.shard(ctx, (alpha, True), nonce, rand)
+        reports.append((nonce, ps, shares))
+    param = (0, ((False,), (True,)), True)
+
+    # The wire cost model (metrics.count_round_bytes — BASELINE's
+    # communication-only numbers): per-round exchange bytes vs the
+    # once-per-collection upload.
+    model = RoundMetrics(level=0, frontier_width=2, padded_width=2,
+                         reports_total=n)
+    count_round_bytes(model, m, param, n)
+    round_bytes = (model.bytes_prep_shares + model.bytes_prep_msgs
+                   + model.bytes_agg_shares)
+    upload_bytes_model = model.bytes_upload
+
+    cfg = SessionConfig(connect_timeout=30.0, exchange_timeout=600.0,
+                        ack_timeout=120.0, round_deadline=1200.0,
+                        shutdown_timeout=5.0, retries=0, backoff=0.2)
+    shapes = [None] + [s.strip() for s in args.wan_shapes.split(",")
+                       if s.strip()]
+    cells = []
+    baseline = None
+    reference = None
+    for shape_text in shapes:
+        if shape_text:
+            os.environ["MASTIC_NET_SHAPE"] = shape_text
+        else:
+            os.environ.pop("MASTIC_NET_SHAPE", None)
+        stamp("wan-cell", shape=shape_text or "unshaped")
+        sess = AggregationSession(m, spec, ctx, vk, config=cfg)
+        try:
+            t0 = time.perf_counter()
+            sess.upload(reports)
+            upload_s = time.perf_counter() - t0
+            upload_wire = sess.coll.wire_bytes()["sent"]
+            sess.round(param)           # warm round (compile-bearing)
+            walls = []
+            for _ in range(max(1, args.wan_rounds)):
+                t0 = time.perf_counter()
+                (result, accept, shares) = sess.round(param)
+                walls.append(time.perf_counter() - t0)
+            wire_meas = sess.coll.wire_bytes()
+        finally:
+            sess.close()
+        outcome = (result, [bool(x) for x in accept], shares)
+        if reference is None:
+            reference = outcome
+        elif outcome != reference:
+            raise RuntimeError(
+                f"parties-wan: shaped link {shape_text!r} changed "
+                f"the aggregate — bit-identity violated")
+        cell = {
+            "shape": shape_text or "unshaped",
+            "upload_s": round(upload_s, 3),
+            "round_wall_s": round(min(walls), 3),
+            "round_walls_s": [round(w, 3) for w in walls],
+            "collector_wire_bytes": wire_meas,
+        }
+        shape = parse_shape(shape_text)
+        if shape is None:
+            baseline = cell
+        else:
+            delta = min(walls) - baseline["round_wall_s"]
+            cell["bandwidth_bytes_per_s"] = shape.bandwidth
+            cell["rtt_s"] = shape.rtt
+            # The upload leg is the CLEAN communication measurement
+            # (no compute in it): measured wall vs the pipe model
+            # over the collector's measured upload bytes validates
+            # that the shaped link actually delivers its shape.
+            cell["upload_model_s"] = round(
+                (upload_wire / shape.bandwidth
+                 if shape.bandwidth > 0 else 0.0) + shape.rtt, 3)
+            cell["comm_delta_s"] = round(delta, 3)
+            # Model: round bytes through the pipe + ~6 sequential
+            # shaped sends on the critical path (agg params, prep
+            # share, resolution, two agg shares), rtt/2 each.
+            cell["comm_model_s"] = round(
+                (round_bytes / shape.bandwidth
+                 if shape.bandwidth > 0 else 0.0)
+                + 6 * shape.rtt / 2, 3)
+            cell["comm_fraction_of_round"] = round(
+                max(0.0, delta) / max(1e-9, min(walls)), 3)
+        cells.append(cell)
+
+    compute_s = baseline["round_wall_s"]
+    crossover = round_bytes / compute_s if compute_s > 0 else 0.0
+    # The measured bracket around the crossover: the slowest shaped
+    # cell still compute-bound and the fastest already comm-bound.
+    above = [c for c in cells if c.get("comm_delta_s") is not None
+             and c["comm_delta_s"] < compute_s]
+    below = [c for c in cells if c.get("comm_delta_s") is not None
+             and c["comm_delta_s"] >= compute_s]
+    return {
+        "bits": bits,
+        "reports": n,
+        "rounds_measured": max(1, args.wan_rounds),
+        "round_bytes_model": round_bytes,
+        "upload_bytes_model": upload_bytes_model,
+        "compute_round_s": compute_s,
+        "crossover_bandwidth_bytes_per_s": round(crossover, 1),
+        "crossover_measured_bracket_bytes_per_s": [
+            min((c["bandwidth_bytes_per_s"] for c in above),
+                default=None),
+            max((c["bandwidth_bytes_per_s"] for c in below),
+                default=None),
+        ],
+        "cells": cells,
+        "note": ("compute_round_s includes the parties' per-round "
+                 "re-trace on this fabric; it cancels in every "
+                 "comm_delta_s (equal work both sides of the delta) "
+                 "but makes the crossover an upper bound"),
+    }
+
+
 def bench_service_overlap(args) -> dict:
     """The `--service-overlap` config (ISSUE 10): aggregate
     multi-tenant reports/s through the LIVE collector service —
@@ -1155,6 +1310,23 @@ def main():
     parser.add_argument("--service-overlap-k", type=int, default=2,
                         help="in-flight tenant rounds for the "
                         "overlapped mode (MASTIC_SERVICE_OVERLAP)")
+    parser.add_argument("--parties-wan", action="store_true",
+                        help="run ONLY the network-separated "
+                        "leader/helper session over the shaped link "
+                        "ladder (MASTIC_NET_SHAPE): per-cell round "
+                        "wall + comm delta, bit-identity asserted, "
+                        "communication-vs-computation crossover "
+                        "stamped (ISSUE 11; PERF.md §13)")
+    parser.add_argument("--wan-bits", type=int, default=4)
+    parser.add_argument("--wan-reports", type=int, default=256)
+    parser.add_argument("--wan-rounds", type=int, default=2,
+                        help="measured rounds per --parties-wan cell "
+                        "(one warm round runs first, excluded)")
+    parser.add_argument("--wan-shapes", type=str,
+                        default="bw=1m:rtt=10ms,bw=128k:rtt=20ms,"
+                                "bw=32k:rtt=40ms,bw=8k:rtt=80ms",
+                        help="comma-separated MASTIC_NET_SHAPE cells "
+                        "for --parties-wan (bw in bytes/s)")
     parser.add_argument("--cold-start", action="store_true",
                         help="measure fresh-process time-to-first-"
                         "round, traced vs warm AOT artifact store "
@@ -1214,6 +1386,30 @@ def main():
         # this process never imports jax (the children's cold start
         # must not inherit a warm runtime).
         run_cold_start_parent(args, timer)
+        return
+
+    if args.parties_wan:
+        # Pure subprocess orchestration too: the parties are the
+        # processes that touch jax; the parent only shards reports
+        # (scalar layer) and drives the session.  Its own metric,
+        # never BENCH_LAST_GOOD.
+        PARTIAL["metric"] = "parties_wan_crossover_bandwidth"
+        for key in ("cached", "cached_provenance", "configs",
+                    "configs_provenance", "vs_baseline"):
+            PARTIAL.pop(key, None)
+        PARTIAL["platform"] = (os.environ.get("JAX_PLATFORMS", "")
+                               or "ambient")
+        stamp("parties-wan", shapes=args.wan_shapes,
+              reports=args.wan_reports)
+        rec = bench_parties_wan(args)
+        PARTIAL["value"] = rec["crossover_bandwidth_bytes_per_s"]
+        PARTIAL["unit"] = "bytes/s"
+        PARTIAL["configs"] = {"parties_wan": rec}
+        timer.cancel()
+        stamp("done",
+              crossover=rec["crossover_bandwidth_bytes_per_s"],
+              compute_s=rec["compute_round_s"])
+        emit()
         return
 
     # Pre-seed the fail-open record from the last verified run BEFORE

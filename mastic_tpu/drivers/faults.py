@@ -35,6 +35,16 @@ additionally honors truncate/corrupt as a content mutation of the
 sealed page's stored bytes, modeling storage corruption the page
 digest must catch).
 
+ISSUE 11 extends the matrix to the network edge: the HTTP upload
+front (mastic_tpu/net/ingest.py, party=collector) fires checkpoint
+``http_accept`` per request (kill/hang/delay) and the `on_blob`
+content seam ``http_body`` over each received upload body
+(truncate/corrupt model an upload mangled in flight — which must be
+rejected with an attributed reason, never admitted), and the shaped
+party links (net/transport.py, party = the sending process) fire
+checkpoint ``net_send`` per outbound frame, so the whole action
+matrix reaches the wide-area transport too.
+
 Each process parses `MASTIC_FAULTS` itself and keeps only the rules
 addressed to its own party name, so one env var arms the whole
 session (the collector passes it through to the party processes).

@@ -564,7 +564,7 @@ class HeavyHittersRun:
         span = handle.pop("span", None)
         if span is not None:
             if error is not None:
-                span.attrs.setdefault("error", type(error).__name__)
+                span.set_default("error", type(error).__name__)
             obs_trace.get_tracer().end_span(span)
 
     def result(self) -> list:

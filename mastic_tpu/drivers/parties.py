@@ -480,13 +480,23 @@ def party_main(argv: list[str]) -> None:
     party = AggregatorParty(mastic, agg_id,
                             bytes.fromhex(cfg["verify_key"]),
                             bytes.fromhex(cfg["ctx"]))
-    trace("engine up, connecting")
+    # Network-separated deployment realism (ISSUE 11): every link
+    # this party sends on is paced by MASTIC_NET_SHAPE (bandwidth /
+    # RTT / jitter) — each process parses the lever itself, exactly
+    # like MASTIC_FAULTS, so one env var shapes the whole session.
+    from ..net.transport import shape_from_env
+
+    shaper = shape_from_env()
+    trace("engine up, connecting"
+          + (" (shaped link)" if shaper is not None else ""))
 
     coll = session_mod.connect(
         "127.0.0.1", cfg["collector_port"], "collector",
-        config.connect_timeout, config.exchange_timeout, injector)
+        config.connect_timeout, config.exchange_timeout, injector,
+        shaper=shaper)
     try:
-        _party_loop(party, coll, config, injector, trace, checkpoint)
+        _party_loop(party, coll, config, injector, trace, checkpoint,
+                    shaper=shaper)
     except SessionError as err:
         trace(f"session error: {err}")
         nak = json.dumps({"party": err.party, "step": err.step,
@@ -501,7 +511,7 @@ def party_main(argv: list[str]) -> None:
 
 def _party_loop(party: AggregatorParty, coll: Channel,
                 config: SessionConfig, injector, trace,
-                checkpoint) -> None:
+                checkpoint, shaper=None) -> None:
     agg_id = party.agg_id
     mastic = party.m
     coll.send_msg(bytes([agg_id]), "hello")
@@ -513,7 +523,8 @@ def _party_loop(party: AggregatorParty, coll: Channel,
         trace("listening for helper")
         peer = session_mod.accept(lst, "helper",
                                   config.connect_timeout,
-                                  config.exchange_timeout, injector)
+                                  config.exchange_timeout, injector,
+                                  shaper=shaper)
         lst.close()
     else:
         port_msg = coll.recv_msg("leader_port")
@@ -523,7 +534,8 @@ def _party_loop(party: AggregatorParty, coll: Channel,
                                "no leader port from collector")
         peer = session_mod.connect(
             "127.0.0.1", int.from_bytes(port_msg, "little"), "leader",
-            config.connect_timeout, config.exchange_timeout, injector)
+            config.connect_timeout, config.exchange_timeout, injector,
+            shaper=shaper)
     trace("peer channel up")
 
     while True:
@@ -685,6 +697,12 @@ class ProcessCollector:
         # comes up clean (otherwise a kill-at-step fault would kill
         # every respawn and recovery could never be tested or used).
         self._arm_child_faults = True
+        # The collector's own sends ride the same shaped link the
+        # parties arm from MASTIC_NET_SHAPE (upload bodies are the
+        # largest payloads of a session — the crossover bench needs
+        # them paced too).
+        from ..net.transport import shape_from_env
+        self.shaper = shape_from_env()
         self.procs: list = []
         self.server: Optional[socket.socket] = None
         self.leader: Optional[Channel] = None
@@ -746,7 +764,8 @@ class ProcessCollector:
             try:
                 chan = session_mod.accept(
                     self.server, "party", cfg.connect_timeout,
-                    cfg.exchange_timeout, self.injector)
+                    cfg.exchange_timeout, self.injector,
+                    shaper=self.shaper)
                 hello = chan.recv_msg("hello")
             except SessionError as err:
                 raise self._attributed(err)
@@ -809,6 +828,18 @@ class ProcessCollector:
             raise
         if self._upload_bodies is not None:
             self._send_upload()
+
+    def wire_bytes(self) -> dict:
+        """Measured collector-side wire traffic (the Channel
+        counters).  Party<->party prep-exchange bytes are invisible
+        from here; `metrics.count_round_bytes`' model covers those —
+        the crossover bench stamps both."""
+        out = {"sent": 0, "received": 0}
+        for chan in (self.leader, self.helper):
+            if chan is not None:
+                out["sent"] += chan.sent_bytes
+                out["received"] += chan.recv_bytes
+        return out
 
     def _party_status(self) -> str:
         out = []

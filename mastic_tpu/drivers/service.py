@@ -617,6 +617,16 @@ class _Tenant:
         return f"oldest-epoch-first dropped epoch {victim.epoch_id} " \
                f"({lost} reports)"
 
+    def count_front_shed(self, reason: str, n: int = 1) -> None:
+        """One front-door (network-admission, ISSUE 11) refusal into
+        this tenant's shed ledger — rate limit, connection ceiling,
+        body-size gate, truncated body.  The door's policy decisions
+        and the service's read as one accounting (the ledger locks
+        itself; buffer state is untouched)."""
+        self.counters.inc("shed", n)
+        self.counters.bump_shed(reason, n)
+        obs_trace.event("shed", tenant=self.spec.name, reason=reason)
+
     def seal_page(self, page: ReportPage, injector=None) -> None:
         """Seal one just-swapped-out page behind its digest and
         append it to the sealed list.  Called WITHOUT the lock — the
@@ -762,11 +772,16 @@ class CollectorService:
 
     def stop_ingest(self) -> None:
         """Quiesce the ingest front: land everything queued, retire
-        the workers.  Idempotent; submit() admits in-process after."""
+        the workers.  Idempotent; submit() admits in-process after.
+        The unpublish happens under the control-plane mutex — an
+        HTTP handler thread may be mid-submit reading `_ingest`
+        (ISSUE 11), and a torn read there would route its upload
+        around the queue the caller just flushed."""
         if self._ingest is not None:
             self._ingest.flush()
             self._ingest.stop()
-            self._ingest = None
+            with self._tenants_mu:
+                self._ingest = None
 
     def flush_ingest(self) -> None:
         """Barrier: every upload submitted so far has fully landed
@@ -829,13 +844,18 @@ class CollectorService:
             # backpressure, shed with its own reason.
             if self._ingest.offer(tenant, blob):
                 return (QUEUED, "")
-            with t.lock:
-                t.counters.inc("shed")
-                t.counters.bump_shed("ingest-queue-full")
-            obs_trace.event("shed", tenant=tenant,
-                            reason="ingest-queue-full")
+            t.count_front_shed("ingest-queue-full")
             return (SHED, "ingest-queue-full")
         return self._ingest_one(tenant, blob)
+
+    def shed_external(self, tenant: str, reason: str,
+                      n: int = 1) -> None:
+        """One front-door refusal (ISSUE 11: the network admission
+        layer) attributed into the tenant's shed ledger exactly like
+        an in-service shed — `_Tenant.count_front_shed` has the
+        story.  Unknown tenants can't reach here (the front 404s
+        before a ledger exists to blame)."""
+        self.tenants[tenant].count_front_shed(reason, n)
 
     def _ingest_one(self, tenant: str, blob: bytes) -> tuple:
         """Decode-validate one upload and land the verdict — the

@@ -167,14 +167,26 @@ class Channel:
     "collector"); `injector` (drivers/faults.py) mutates outbound
     frames when the MASTIC_FAULTS lever is armed.  Framing matches
     `wire.frame`: 4-byte LE length prefix.
+
+    `transport` (ISSUE 11, `mastic_tpu/net/transport.py`) owns HOW a
+    framed byte string reaches the socket: None is the plain inline
+    sendall; a `ShapedTransport` paces every frame by the configured
+    bandwidth/RTT/jitter (`MASTIC_NET_SHAPE`) and fires the
+    `net_send` fault checkpoint — network-separated parties over a
+    link with wide-area realism.  `sent_bytes`/`recv_bytes` count
+    wire traffic either way (the crossover bench reads them).
     """
 
     def __init__(self, sock: socket.socket, remote: str,
-                 timeout: float = 600.0, injector=None):
+                 timeout: float = 600.0, injector=None,
+                 transport=None):
         self.sock = sock
         self.remote = remote
         self.timeout = timeout
         self.injector = injector
+        self.transport = transport
+        self.sent_bytes = 0
+        self.recv_bytes = 0
         # Blocking sockets with per-call settimeout; disable Nagle so
         # small protocol messages don't wait on the ack clock.
         try:
@@ -225,6 +237,7 @@ class Channel:
                     f"connection closed mid-frame "
                     f"({len(buf)}/{n} bytes)")
             buf += chunk
+            self.recv_bytes += len(chunk)
         return bytes(buf)
 
     # -- framed messages -------------------------------------------
@@ -237,12 +250,18 @@ class Channel:
         for frame in frames:
             self.sock.settimeout(self._budget(deadline, step))
             try:
-                # mastic-allow: SF004 — the Channel is the transport
-                # seam BELOW the codec layer: every payload handed to
-                # send_msg is screened at its call site (that is
-                # where the whole-program rule fires), so flagging
-                # the framing loop again would double-count
-                self.sock.sendall(frame)
+                if self.transport is not None:
+                    self.transport.send(frame)
+                else:
+                    # mastic-allow: SF004 — the Channel is the
+                    # transport seam BELOW the codec layer: every
+                    # payload handed to send_msg is screened at its
+                    # call site (that is where the whole-program
+                    # rule fires), so flagging the framing write
+                    # again would double-count (the shaped branch
+                    # above is the same seam, one layer down)
+                    self.sock.sendall(frame)
+                self.sent_bytes += len(frame)
             except socket.timeout:
                 raise SessionError(self.remote, step, KIND_TIMEOUT,
                                    "send blocked past the deadline")
@@ -271,6 +290,7 @@ class Channel:
                                f"socket error: {exc}")
         if not first:
             return None
+        self.recv_bytes += len(first)
         header = first if len(first) == 4 else \
             first + self._recv_exact(4 - len(first), step, deadline,
                                      timeout)
@@ -284,9 +304,22 @@ class Channel:
             self._note_best_effort("close")
 
 
+def _make_transport(sock: socket.socket, shaper, injector):
+    """Wrap a just-built channel socket in a shaped transport when a
+    link shape is armed (None stays the plain inline path)."""
+    if shaper is None:
+        return None
+    from ..net.transport import for_socket
+
+    return for_socket(sock, shaper, injector)
+
+
 def connect(host: str, port: int, remote: str, timeout: float,
-            exchange_timeout: float, injector=None) -> Channel:
-    """Deadline-bounded create_connection -> Channel."""
+            exchange_timeout: float, injector=None,
+            shaper=None) -> Channel:
+    """Deadline-bounded create_connection -> Channel.  `shaper` is a
+    `net.transport.LinkShape` (MASTIC_NET_SHAPE) applied to this
+    end's sends."""
     try:
         sock = socket.create_connection((host, port), timeout=timeout)
     except socket.timeout:
@@ -296,11 +329,13 @@ def connect(host: str, port: int, remote: str, timeout: float,
     except OSError as exc:
         raise SessionError(remote, "connect", KIND_CLOSED,
                            f"connect to {host}:{port} failed: {exc}")
-    return Channel(sock, remote, exchange_timeout, injector)
+    return Channel(sock, remote, exchange_timeout, injector,
+                   transport=_make_transport(sock, shaper, injector))
 
 
 def accept(server: socket.socket, remote: str, timeout: float,
-           exchange_timeout: float, injector=None) -> Channel:
+           exchange_timeout: float, injector=None,
+           shaper=None) -> Channel:
     """Deadline-bounded server.accept() -> Channel."""
     server.settimeout(timeout)
     try:
@@ -311,7 +346,8 @@ def accept(server: socket.socket, remote: str, timeout: float,
     except OSError as exc:
         raise SessionError(remote, "accept", KIND_CLOSED,
                            f"accept failed: {exc}")
-    return Channel(sock, remote, exchange_timeout, injector)
+    return Channel(sock, remote, exchange_timeout, injector,
+                   transport=_make_transport(sock, shaper, injector))
 
 
 def with_retries(fn: Callable, attempts: int, backoff: float,

@@ -1,0 +1,41 @@
+"""The network front (ISSUE 11, ROADMAP item 1): the collector as a
+real network service.
+
+The Mastic draft is designed to ride DAP-style HTTPS upload/aggregate
+flows between genuinely separate parties; everything below this
+package runs in one process or over loopback pipes spawned by one
+parent.  This package is the missing edge, in three legs:
+
+* `net/ingest.py` — a threaded HTTP upload endpoint framed DAP-style
+  (versioned ``PUT /v1/tenants/{id}/reports`` carrying the dual-view
+  report blob, content-length/media-type gates, structured JSON error
+  bodies with the r8 reason codes) feeding the bounded-queue
+  `CollectorService.submit()` seam;
+
+* `net/admission.py` — the per-IP token-bucket + connection-limit
+  admission layer in front of it, composing with the service's
+  quota/shed machinery so every rejection lands in
+  `ServiceCounters.shed_reasons` and the obs registry, never silent;
+
+* `net/transport.py` — a `Transport` abstraction under the r8
+  `Channel` (the existing socket path plus a `ShapedTransport`
+  injecting configurable bandwidth/RTT/jitter), so the leader and
+  helper run as network-separated parties over a link with
+  bandwidth-delay realism (`MASTIC_NET_SHAPE`);
+
+* `net/loadgen.py` — a closed-loop open/closed hybrid load generator
+  simulating 10^5-10^6 clients (zipf tenant/client mix, Poisson
+  arrivals with bursts, a configurable malformed fraction) that
+  drives the upload endpoint and stamps p50/p95/p99 admission
+  latency, reports/s and shed/quarantine accounting
+  (`tools/loadgen.py`; the `serve-load` bench cell).
+
+Import submodules explicitly (``from mastic_tpu.net import ingest``):
+`ingest` pulls in the driver stack, while `transport`/`admission`
+stay stdlib-light so `drivers/parties.py` can import shaping without
+a cycle.  USAGE.md "Network front" has the endpoint spec, the
+`MASTIC_NET_*` lever table and loadgen recipes; PERF.md §13 has the
+measured SLO and communication-vs-computation crossover.
+"""
+
+__all__ = ["admission", "ingest", "loadgen", "transport"]

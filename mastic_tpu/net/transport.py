@@ -1,0 +1,167 @@
+"""Link transports under the r8 `Channel` (ISSUE 11): the plain
+socket path, plus a `ShapedTransport` that injects bandwidth, RTT and
+jitter so the process-separated parties run over a link with
+wide-area realism instead of an infinitely fast loopback.
+
+The session layer stays the owner of framing, deadlines and fault
+injection; a transport only decides HOW a fully framed byte string
+reaches the socket.  `ShapedTransport` models the link on the send
+side (both ends shape their own sends, so a bidirectional exchange
+pays the shape in both directions):
+
+    delay(frame) = rtt/2 + U(0, jitter) + len(frame)/bandwidth
+
+with the jitter drawn from a SEEDED generator per transport — a
+shaped run is replayable, exactly like the fault harness whose clock
+(`time.sleep`) it borrows.  The `net_send` fault checkpoint fires per
+frame before any pacing, so the whole drop/delay/truncate/corrupt/
+hang matrix composes with shaping at the same seam.
+
+`MASTIC_NET_SHAPE` arms it process-wide (every process of a session
+parses the lever itself, like `MASTIC_FAULTS`):
+
+    MASTIC_NET_SHAPE="bw=1m:rtt=20ms:jitter=2ms[:seed=N]"
+
+bw is BYTES/second with optional k/m/g multiplier (0 = unlimited);
+rtt/jitter accept a trailing "ms" or "s" (plain numbers are seconds).
+BASELINE.md's communication-only numbers extend through this into the
+measured communication-vs-computation crossover (`bench.py
+--parties-wan`; PERF.md §13).
+"""
+
+import random
+import socket
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass
+class LinkShape:
+    """One direction of a shaped link (each end applies it to its own
+    sends)."""
+
+    bandwidth: float = 0.0   # bytes/second; 0 = unlimited
+    rtt: float = 0.0         # full round-trip seconds (rtt/2 a send)
+    jitter: float = 0.0      # max extra seconds, uniform, seeded
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.bandwidth < 0 or self.rtt < 0 or self.jitter < 0:
+            raise ValueError("link shape values must be >= 0")
+
+
+_BW_UNITS = {"k": 1e3, "m": 1e6, "g": 1e9}
+
+
+def _parse_seconds(val: str, field: str) -> float:
+    val = val.strip().lower()
+    scale = 1.0
+    if val.endswith("ms"):
+        (val, scale) = (val[:-2], 1e-3)
+    elif val.endswith("s"):
+        val = val[:-1]
+    try:
+        return float(val) * scale
+    except ValueError:
+        raise ValueError(f"link shape {field} must be seconds or "
+                         f"'<n>ms', got {val!r}")
+
+
+def parse_shape(text: Optional[str]) -> Optional[LinkShape]:
+    """Parse a MASTIC_NET_SHAPE spec; None/empty means unshaped.
+    Unknown keys are errors — a typo'd shape that silently runs at
+    loopback speed would make every WAN number vacuous (the
+    parse_faults stance)."""
+    if text is None or not text.strip():
+        return None
+    kwargs: dict = {}
+    for chunk in text.split(":"):
+        chunk = chunk.strip()
+        if not chunk:
+            continue
+        if "=" not in chunk:
+            raise ValueError(f"malformed link-shape field {chunk!r} "
+                             f"(want key=value)")
+        (key, val) = chunk.split("=", 1)
+        key = key.strip()
+        val = val.strip().lower()
+        if key == "bw":
+            scale = 1.0
+            if val and val[-1] in _BW_UNITS:
+                scale = _BW_UNITS[val[-1]]
+                val = val[:-1]
+            try:
+                kwargs["bandwidth"] = float(val) * scale
+            except ValueError:
+                raise ValueError(f"link shape bw must be bytes/s "
+                                 f"with optional k/m/g, got {val!r}")
+        elif key in ("rtt", "jitter"):
+            kwargs[key] = _parse_seconds(val, key)
+        elif key == "seed":
+            kwargs["seed"] = int(val)
+        else:
+            raise ValueError(f"unknown link-shape key {key!r} (must "
+                             f"be bw, rtt, jitter or seed)")
+    return LinkShape(**kwargs)
+
+
+def shape_from_env() -> Optional[LinkShape]:
+    import os
+
+    return parse_shape(os.environ.get("MASTIC_NET_SHAPE"))
+
+
+class Transport:
+    """The plain path: frames go straight to the socket.  Counts
+    bytes so callers (bench, tests) can attribute wire traffic."""
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.bytes_sent = 0
+        self.frames_sent = 0
+
+    def send(self, frame: bytes) -> None:
+        self.sock.sendall(frame)
+        self.bytes_sent += len(frame)
+        self.frames_sent += 1
+
+
+class ShapedTransport(Transport):
+    """Bandwidth/RTT/jitter pacing ahead of every frame, plus the
+    `net_send` fault checkpoint — the link-layer twin of the
+    checkpoints the party main loops fire between protocol steps."""
+
+    def __init__(self, sock: socket.socket, shape: LinkShape,
+                 injector=None):
+        super().__init__(sock)
+        self.shape = shape
+        self.injector = injector
+        self._rng = random.Random(shape.seed)
+        self.slept_s = 0.0
+
+    def send(self, frame: bytes) -> None:
+        if self.injector is not None:
+            self.injector.checkpoint("net_send")
+        shape = self.shape
+        delay = shape.rtt / 2.0
+        if shape.jitter > 0:
+            delay += self._rng.uniform(0.0, shape.jitter)
+        if shape.bandwidth > 0:
+            delay += len(frame) / shape.bandwidth
+        if delay > 0:
+            time.sleep(delay)
+            self.slept_s += delay
+        super().send(frame)
+
+
+def for_socket(sock: socket.socket,
+               shape: Optional[LinkShape] = None,
+               injector=None) -> Optional[Transport]:
+    """The transport for a just-built channel socket: None when
+    unshaped (the Channel's inline sendall is the plain path — no
+    wrapper object per frame on the fast path), a ShapedTransport
+    when a shape is armed."""
+    if shape is None:
+        return None
+    return ShapedTransport(sock, shape, injector)

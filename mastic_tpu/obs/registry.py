@@ -116,6 +116,15 @@ DECLARED = {
     "mastic_ingest_queue_depth":
         ("gauge", "uploads waiting in the concurrent ingest front's "
          "bounded queue", ()),
+    "mastic_net_http_requests_total":
+        ("counter", "upload-front HTTP requests by response code "
+         "(mastic_tpu/net/ingest.py)", ("code",)),
+    "mastic_net_admission_latency_ms":
+        ("histogram", "upload-front request latency: accept to "
+         "verdict written, per PUT", ()),
+    "mastic_net_active_connections":
+        ("gauge", "upload-front requests currently being served "
+         "(bounded by MASTIC_NET_MAX_CONNS)", ()),
 }
 
 

@@ -46,34 +46,51 @@ DEFAULT_CAPACITY = 4096
 
 class Span:
     """One timed operation.  Created by Tracer.span / start_span;
-    mutated only by its owning thread until `end`, after which it is
-    frozen in the ring."""
+    by convention mutated only by its owning thread until `end` —
+    and since ISSUE 11 (the upload front put server threads next to
+    the scheduler everywhere) the convention is enforced: every
+    post-construction mutation happens under the span's own lock, so
+    a mis-shared span degrades to racy-but-sound instead of torn."""
 
     __slots__ = ("name", "span_id", "parent_id", "t_start_ms",
-                 "duration_ms", "attrs", "events", "_tracer")
+                 "duration_ms", "attrs", "events", "_tracer",
+                 "_lock")
 
     def __init__(self, name: str, span_id: int,
                  parent_id: Optional[int], t_start_ms: float,
-                 attrs: dict, tracer: "Tracer"):
+                 attrs: dict, tracer: "Tracer",
+                 duration_ms: Optional[float] = None):
         self.name = name
         self.span_id = span_id
         self.parent_id = parent_id
         self.t_start_ms = t_start_ms
-        self.duration_ms: Optional[float] = None
+        # Pre-set only by Tracer.record_span (the already-finished
+        # single-call form); live spans get it at end_span.
+        self.duration_ms: Optional[float] = duration_ms
         self.attrs = attrs
         self.events: list = []
         self._tracer = tracer
+        self._lock = threading.Lock()
 
     def set(self, **attrs) -> "Span":
-        self.attrs.update(attrs)
+        with self._lock:
+            self.attrs.update(attrs)
         return self
 
+    def set_default(self, name: str, value) -> None:
+        """`attrs.setdefault`, under the span lock (the error-attr
+        stamp the drivers' collect paths use)."""
+        with self._lock:
+            self.attrs.setdefault(name, value)
+
     def event(self, name: str, **attrs) -> None:
-        self.events.append({
-            "name": name,
-            "t_ms": round(self._tracer.now_ms(), 3),
-            "attrs": attrs,
-        })
+        t_ms = round(self._tracer.now_ms(), 3)
+        with self._lock:
+            self.events.append({
+                "name": name,
+                "t_ms": t_ms,
+                "attrs": attrs,
+            })
 
     def as_dict(self) -> dict:
         return {
@@ -103,8 +120,7 @@ class _SpanContext:
 
     def __exit__(self, exc_type, exc, tb) -> None:
         if exc_type is not None:
-            self._span.attrs.setdefault("error",
-                                        exc_type.__name__)
+            self._span.set_default("error", exc_type.__name__)
         self._tracer.end_span(self._span)
 
 
@@ -201,8 +217,33 @@ class Tracer:
             stack.pop()
         return sp
 
+    def record_span(self, name: str, duration_ms: float = 0.0,
+                    parent: Optional[Span] = None, **attrs) -> Span:
+        """One ALREADY-FINISHED span in a single call — the form for
+        server/handler threads (the upload front's `net.request`,
+        ISSUE 11): every field lands in the constructor, so there is
+        no post-construction mutation for another thread to race
+        (the CC001 ownership story, by construction instead of by
+        promise), and the ring/sink append is the same lock-guarded
+        `_record` every span takes.  Never touches the thread-local
+        stack."""
+        with self._lock:
+            self._seq += 1
+            span_id = self._seq
+        sp = Span(name, span_id,
+                  parent.span_id if parent is not None else None,
+                  self.now_ms() - duration_ms, dict(attrs), self,
+                  duration_ms=duration_ms)
+        self._record(sp)
+        return sp
+
     def end_span(self, span: Span) -> None:
-        span.duration_ms = self.now_ms() - span.t_start_ms
+        # Under the tracer lock: ending is the only cross-thread-
+        # visible mutation a span ever gets (record_span's are all
+        # constructor-time), and the ring append below re-takes the
+        # same lock anyway.
+        with self._lock:
+            span.duration_ms = self.now_ms() - span.t_start_ms
         stack = self._stack()
         if stack and stack[-1] is span:
             stack.pop()
@@ -235,8 +276,9 @@ class Tracer:
         if cur is not None:
             cur.event(name, **attrs)
             return
-        sp = self.start_span(name, **attrs)
-        sp.attrs["standalone_event"] = True
+        # The marker rides the constructor (record_span discipline:
+        # no post-construction span mutation off the owning thread).
+        sp = self.start_span(name, standalone_event=True, **attrs)
         self.end_span(sp)
 
     # -- ring / sink -----------------------------------------------

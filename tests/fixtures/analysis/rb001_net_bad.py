@@ -1,0 +1,10 @@
+"""Known-bad (ISSUE 11, network-front flavor): an HTTP upload
+handler whose connection reads never arm a deadline (RB001) — a
+client stalling mid-body wedges the handler thread forever."""
+
+
+class Handler:
+    def handle_upload(self):
+        (conn, _addr) = self.server.accept()
+        header = conn.recv(4)
+        return header

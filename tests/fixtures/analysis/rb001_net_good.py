@@ -1,0 +1,11 @@
+"""Known-good twin of rb001_net_bad: the connection is deadline-
+bounded before any read (the net/ingest.py setup() pattern)."""
+
+
+class Handler:
+    def handle_upload(self, io_timeout: float):
+        self.server.settimeout(io_timeout)
+        (conn, _addr) = self.server.accept()
+        conn.settimeout(io_timeout)
+        header = conn.recv(4)
+        return header

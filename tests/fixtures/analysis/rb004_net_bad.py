@@ -1,0 +1,16 @@
+"""Known-bad (ISSUE 11, network-front flavor): a per-client rate
+table that grows one entry per address forever (RB004) — a hostile
+address stream converts the admission layer itself into the OOM."""
+import collections
+import queue
+
+
+def make_front_state():
+    buckets = queue.Queue()            # no maxsize: unbounded
+    pending_bodies = collections.deque()   # no maxlen: unbounded
+    return (buckets, pending_bodies)
+
+
+def accept_loop(listener, pending_bodies):
+    while True:
+        pending_bodies.append(listener.take())

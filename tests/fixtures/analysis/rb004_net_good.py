@@ -1,0 +1,18 @@
+"""Known-good twin of rb004_net_bad: every front buffer carries a
+capacity bound, and the accept loop sheds at the bound (the
+net/admission.py LRU-evicted bucket table pattern)."""
+import collections
+import queue
+
+
+def make_front_state(bound: int):
+    buckets = queue.Queue(maxsize=bound)
+    pending_bodies = collections.deque(maxlen=bound)
+    return (buckets, pending_bodies)
+
+
+def accept_loop(listener, pending_bodies, bound: int):
+    while True:
+        if len(pending_bodies) >= bound:
+            break
+        pending_bodies.append(listener.take())

@@ -1,0 +1,13 @@
+"""Known-bad (ISSUE 11, network-front flavor): an HTTP error body
+that echoes key-derived detail back to the client (SF004) — the
+exact leak the upload front's fixed-string error bodies exist to
+rule out."""
+import json
+
+
+def error_body(key):
+    return json.dumps({"error": "rejected", "detail": key.hex()})
+
+
+def respond(wfile, key):
+    wfile.write(error_body(key))

@@ -1,0 +1,13 @@
+"""Known-good twin of sf004_net_bad: the error body is built from
+fixed strings and public reason names only (the net/ingest.py error
+contract); the key never reaches the response."""
+import json
+
+
+def error_body(reason: str) -> str:
+    return json.dumps({"error": "quarantined", "reason": reason})
+
+
+def respond(wfile, key):
+    del key   # authenticates the tenant upstream; never echoed
+    wfile.write(error_body("malformed"))

@@ -327,3 +327,54 @@ def test_cli_json_output():
     assert proc.returncode == 1
     payload = json.loads(proc.stdout)
     assert payload["findings"][0]["rule"] == "SF001"
+
+
+# -- ISSUE 11: the network-front scope extension ----------------------
+
+# Net-flavored fixture pairs: the same rules, modeled on the failure
+# shapes an internet-facing upload door has (deadline-less handler
+# reads, unbounded per-client tables, secret-bearing HTTP error
+# bodies).  They ride NEXT TO the canonical pairs in CASES — every
+# rule keeps exactly one canonical pair there; these prove the rules
+# catch the network shapes too.
+NET_CASES = {
+    "RB001": ("rb001_net_bad.py", "rb001_net_good.py", "robustness"),
+    "RB004": ("rb004_net_bad.py", "rb004_net_good.py", "robustness"),
+    "SF004": ("sf004_net_bad.py", "sf004_net_good.py", "secretflow"),
+}
+
+
+@pytest.mark.parametrize("rule", sorted(NET_CASES))
+def test_net_bad_fixture_is_flagged(rule):
+    (bad, _good, pass_name) = NET_CASES[rule]
+    (findings, _suppressed) = run_fixture(bad, pass_name)
+    rules_hit = {f.rule for f in findings}
+    assert rules_hit == {rule}, (
+        f"{bad} must trigger {rule} and only {rule}; got "
+        f"{[f.text() for f in findings]}")
+
+
+@pytest.mark.parametrize("rule", sorted(NET_CASES))
+def test_net_good_fixture_is_clean(rule):
+    (_bad, good, pass_name) = NET_CASES[rule]
+    (findings, suppressed) = run_fixture(good, pass_name)
+    assert findings == [] and suppressed == [], (
+        f"{good} must be clean; got {[f.text() for f in findings]}")
+
+
+def test_net_package_is_in_analyzer_scope():
+    """mastic_tpu/net/ is inside both the robustness and the
+    whole-program secret-flow reporting scopes (ISSUE 11): a
+    deadline-less read or a secret-bearing error body in the network
+    front must be a finding, not a blind spot."""
+    from tools.analysis import robustness, secretflow
+
+    for rel in ("mastic_tpu/net/ingest.py",
+                "mastic_tpu/net/admission.py",
+                "mastic_tpu/net/transport.py",
+                "mastic_tpu/net/loadgen.py"):
+        assert robustness.in_scope(rel), rel
+        assert secretflow.wp_in_scope(rel), rel
+    assert robustness.in_scope("tools/loadgen.py")
+    assert secretflow.wp_in_scope("tools/loadgen.py")
+    assert not robustness.in_scope("mastic_tpu/ops/field_jax.py")

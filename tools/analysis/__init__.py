@@ -10,10 +10,12 @@ whose contract it checks:
   tracesafe   TS001-TS004   mastic_tpu/ops/, backend/, flp/flp_jax.py
   dtypes      DT001-DT003   mastic_tpu/ops/ (field/AES/Keccak kernels)
   secretflow  SF001-SF002   vidpf.py, mastic.py, aes.py, xof.py
-              SF003-SF005   whole-program: drivers/, obs/,
-                            metrics.py, tools/serve.py
+              SF003-SF005   whole-program: drivers/, obs/, net/,
+                            metrics.py, tools/serve.py,
+                            tools/loadgen.py
   pallasck    PL001-PL004   any file calling pallas_call
-  robustness  RB001-RB005   mastic_tpu/drivers/ + tools/serve.py
+  robustness  RB001-RB005   mastic_tpu/drivers/ + mastic_tpu/net/
+                            + tools/serve.py + tools/loadgen.py
   observability OB001       mastic_tpu/ library code
   concurrency CC001-CC004   whole-program: drivers/, obs/,
                             tools/serve.py (threads + locks)

@@ -1,8 +1,10 @@
-"""Pass 5 — robustness of the session/driver layer.
+"""Pass 5 — robustness of the session/driver/network layer.
 
-Scope: mastic_tpu/drivers/ — the layer that owns sockets, subprocess
-lifecycles, and fault handling (ISSUE 3).  Two failure modes this
-pass keeps out of the tree:
+Scope: mastic_tpu/drivers/ and mastic_tpu/net/ — the layers that own
+sockets, subprocess lifecycles, the HTTP upload front, and fault
+handling (ISSUE 3; net/ since ISSUE 11 — a network-facing door has
+exactly these failure modes, at internet exposure).  Failure modes
+this pass keeps out of the tree:
 
   RB001  a blocking socket read with no deadline.  Flags calls to
          `.accept()` / `.recv()` / `.makefile()` in a scope that
@@ -71,18 +73,18 @@ RULES = {
     "RB005": "deadline-less while loop in service scheduler code",
 }
 
-SCOPE_PREFIX = "mastic_tpu/drivers/"
+SCOPE_PREFIXES = ("mastic_tpu/drivers/", "mastic_tpu/net/")
 
-# The service CLI lives in tools/ but owns the same long-lived-loop
-# failure modes the drivers do.
-EXTRA_FILES = ("tools/serve.py",)
+# The service/load CLIs live in tools/ but own the same
+# long-lived-loop failure modes the drivers do.
+EXTRA_FILES = ("tools/serve.py", "tools/loadgen.py")
 
 _BLOCKING_READS = {"accept", "recv", "recv_into", "makefile"}
 _CONNECT_FNS = {"create_connection"}
 
 
 def in_scope(rel: str) -> bool:
-    return rel.startswith(SCOPE_PREFIX) or rel in EXTRA_FILES
+    return rel.startswith(SCOPE_PREFIXES) or rel in EXTRA_FILES
 
 
 def _scopes(tree: ast.Module):

@@ -34,7 +34,9 @@ boundaries through the call graph (`callgraph.Program`): a tainted
 argument taints the callee's parameter, a function whose return value
 is tainted taints every resolved call site, iterated to a fixpoint.
 Reported over the service plane (mastic_tpu/drivers/, mastic_tpu/obs/,
-mastic_tpu/metrics.py, tools/serve.py):
+mastic_tpu/net/, mastic_tpu/metrics.py, tools/serve.py,
+tools/loadgen.py — the network front's HTTP error bodies are egress
+at internet exposure, ISSUE 11):
 
   SF003  tainted value reaching a TELEMETRY sink: span attrs/events
          (`event`, `start_span`, `span`, `.set`), registry series
@@ -270,8 +272,13 @@ def check(info) -> list:
 # ====================================================================
 
 # Where the whole-program rules REPORT (taint is tracked everywhere).
-WP_SCOPE_PREFIXES = ("mastic_tpu/drivers/", "mastic_tpu/obs/")
-WP_SCOPE_FILES = ("tools/serve.py", "mastic_tpu/metrics.py")
+# mastic_tpu/net/ since ISSUE 11: the HTTP upload front's error
+# bodies and the load generator are process egress at internet
+# exposure — they must be PROVEN secret-free, not assumed.
+WP_SCOPE_PREFIXES = ("mastic_tpu/drivers/", "mastic_tpu/obs/",
+                     "mastic_tpu/net/")
+WP_SCOPE_FILES = ("tools/serve.py", "tools/loadgen.py",
+                  "mastic_tpu/metrics.py")
 
 # The service plane adds key-binding material to the secret attrs.
 _WP_SECRET_ATTRS = _SECRET_ATTRS | {"verify_key"}

@@ -97,6 +97,18 @@ run serve-overlap python bench.py --service-overlap
 run serve-overlap-soak python tools/serve.py --soak 120 --bits 4 \
     --reports 32 --overlap 2 --ingest-threads 2
 
+# 6e. The network front on the chip host (ISSUE 11): the serve-load
+# cell drives the DAP-shaped upload endpoint with 10^6 simulated
+# clients (zipf mix, bursts, adversarial fraction) and stamps
+# p50/p95/p99 admission latency + reports/s + the shed ledger — the
+# first end-to-end SLO cell; parties-wan runs the network-separated
+# leader/helper over the shaped-link ladder and stamps the
+# communication-vs-computation crossover with chip-speed compute
+# (PERF.md §13 tracks both).
+run serve-load python tools/loadgen.py --clients 1000000 \
+    --duration 30 --rate 600 --workers 8 --slo-p99-ms 250
+run parties-wan python bench.py --parties-wan
+
 # 6c. On-chip AOT bake + trace-free load cycle (ISSUE 9,
 # drivers/artifacts.py): bake the cold-start family on the chip,
 # then bench.py --cold-start reuses the store (MASTIC_ARTIFACT_DIR

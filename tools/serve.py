@@ -183,6 +183,79 @@ def check_status_endpoints(status) -> None:
         fail("/varz service snapshot has no tenants")
 
 
+def run_upload_window(args, svc, status):
+    """The HTTP-ingest window (ISSUE 11, `mastic_tpu/net/ingest.py`):
+    serve the DAP-shaped upload endpoint for `--upload-window`
+    seconds — or until a client POSTs the admin drain control — then
+    cut every tenant's buffered pages into epochs and fall through to
+    the normal drain.
+
+    Plane separation: handler threads only admit (`submit()` is the
+    r15 thread-safe seam) and ENQUEUE — epoch cuts and snapshots
+    execute here, on this thread, which owns the whole scheduler
+    plane (the CC001 pass holds the tree to exactly this split).
+    With `--snapshot` an admitted upload enqueues a durability
+    ticket and its 2xx WAITS until this loop has written the
+    snapshot, so a client holding an ack can never lose that report
+    to a kill -9; an un-acked upload is the client's to retry (the
+    DAP upload contract) — `tools/loadgen.py --smoke`'s mid-upload
+    crash drill drives exactly this pair via `--resume`."""
+    import queue as queue_mod
+    import threading
+
+    from mastic_tpu.drivers.session import Deadline
+    from mastic_tpu.net.ingest import UploadFront
+
+    # Durability tickets: bounded, so a hammered endpoint blocks its
+    # handlers at 64 in-flight acks instead of growing.
+    tickets: queue_mod.Queue = queue_mod.Queue(maxsize=64)
+
+    def on_admitted(tenant):
+        done = threading.Event()
+        tickets.put(done)
+        if not done.wait(timeout=60.0):
+            raise RuntimeError("snapshot ticket timed out — the "
+                               "2xx must not outrun durability")
+
+    front = UploadFront(
+        svc, port=args.upload_port, admin=True,
+        injector=svc.injector,
+        on_admitted=(on_admitted if args.snapshot else None)).start()
+    if args.port_file:
+        tmp = args.port_file + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"upload_port": front.port}, f)
+        os.replace(tmp, args.port_file)
+
+    def settle_tickets() -> None:
+        # qsize() is exact here: only this thread pops; a producer
+        # arriving mid-drain settles on the next loop pass.
+        waiting = [tickets.get() for _ in range(tickets.qsize())]
+        if waiting:
+            write_snapshot(svc, args.snapshot)
+            for done in waiting:
+                done.set()
+
+    deadline = Deadline(args.upload_window)
+    while not deadline.expired():
+        drain_now = front.drain_requested.wait(0.02)
+        settle_tickets()
+        for tenant in front.pop_epoch_requests():
+            svc.begin_epoch(tenant)
+        publish_status(status, svc)
+        if drain_now:
+            break
+    front.stop()
+    settle_tickets()
+    for tenant in front.pop_epoch_requests():
+        svc.begin_epoch(tenant)
+    for name in list(svc.tenants):
+        svc.begin_epoch(name)
+    if args.snapshot:
+        write_snapshot(svc, args.snapshot)
+    return front.port
+
+
 def write_snapshot(svc, path: str) -> None:
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
@@ -225,6 +298,22 @@ def main() -> None:
                         help="serve /metrics, /statusz and /varz on "
                              "127.0.0.1:PORT (0 = ephemeral; USAGE.md "
                              "'Observability')")
+    parser.add_argument("--upload-port", type=int, default=None,
+                        help="serve the DAP-shaped HTTP upload "
+                             "endpoint (PUT /v1/tenants/{id}/reports) "
+                             "on 127.0.0.1:PORT for --upload-window "
+                             "seconds before cutting epochs and "
+                             "draining (0 = ephemeral; USAGE.md "
+                             "'Network front')")
+    parser.add_argument("--upload-window", type=float, default=30.0,
+                        help="seconds the upload endpoint accepts "
+                             "reports (a client POST to "
+                             "/v1/admin/drain closes it early)")
+    parser.add_argument("--port-file", type=str, default=None,
+                        help="write the bound upload port as JSON to "
+                             "this path (atomic rename) — how a "
+                             "driver finds an ephemeral --upload-port "
+                             "0")
     parser.add_argument("--overlap", type=int, default=None,
                         help="keep up to K tenants' rounds in flight "
                              "(overlapped epoch executor; sets "
@@ -336,7 +425,13 @@ def main() -> None:
                  status=status)
         return
 
-    if not args.resume:
+    upload_port = None
+    if args.upload_port is not None:
+        # HTTP ingest replaces the synthetic admission loop entirely
+        # (on --resume too: the reopened window is where a client
+        # retries the uploads the crashed process never acked).
+        upload_port = run_upload_window(args, svc, status)
+    elif not args.resume:
         for _ in range(args.epochs):
             reports = build_reports(m_count, b"serve count", rng,
                                     count_values, bits)
@@ -355,6 +450,7 @@ def main() -> None:
     metrics = svc.metrics()
     out = {
         "mode": "resume" if args.resume else "serve",
+        "upload_port": upload_port,
         "platform": jax.devices()[0].platform,
         "bits": bits, "reports": args.reports,
         "epochs": args.epochs,
