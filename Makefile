@@ -33,6 +33,15 @@
 #                epoch drill (tools/serve.py --overlap-drill:
 #                concurrent submit burst through the ingest front +
 #                kill-9 + --resume with MASTIC_SERVICE_OVERLAP=2)
+#   make chaos-smoke  transport-security gate (ISSUE 14): the fast
+#                reconnect / mTLS-negative-matrix / idle-timeout
+#                tests of tests/test_net.py, then a seeded
+#                --chaos-drill — full two-party collections over
+#                TCP+mTLS standalone parties (tools/party.py) under
+#                randomized conn_drop/partition/tls_handshake/
+#                slow_loris schedules, bit-identity + recovery
+#                attribution asserted (USAGE.md "Transport
+#                security")
 #   make net-smoke  network-front gate (mastic_tpu/net/, ISSUE 11):
 #                fast tier of tests/test_net.py (DAP framing golden
 #                vectors, token-bucket/connection admission, network
@@ -74,12 +83,14 @@
 
 PY ?= python
 
-.PHONY: ci lint analyze faults serve-smoke net-smoke obs-smoke \
-	pipeline artifacts-smoke multichip typecheck test-fast test \
-	test-slow test-slow-1 test-slow-2 test-slow-3 bench
+.PHONY: ci lint analyze faults serve-smoke net-smoke chaos-smoke \
+	obs-smoke pipeline artifacts-smoke multichip typecheck \
+	test-fast test test-slow test-slow-1 test-slow-2 test-slow-3 \
+	bench
 
-ci: lint analyze faults serve-smoke net-smoke obs-smoke pipeline \
-	artifacts-smoke multichip typecheck test-fast
+ci: lint analyze faults serve-smoke net-smoke chaos-smoke \
+	obs-smoke pipeline artifacts-smoke multichip typecheck \
+	test-fast
 
 faults:
 	$(PY) -m pytest tests/test_faults.py -q -m "not slow"
@@ -102,6 +113,15 @@ net-smoke:
 	$(PY) -m pytest tests/test_net.py -q -m "not slow"
 	$(PY) -m pytest -q "tests/test_net.py::test_shaped_parties_bit_identical_to_in_process"
 	JAX_PLATFORMS=cpu $(PY) tools/loadgen.py --smoke
+
+# The fast tier of test_net.py already ran in net-smoke; this gate
+# re-runs only the ISSUE 14 transport-security selection (cheap, no
+# compile) and then the real campaign: certs minted, standalone
+# mTLS parties spawned, three seeded chaos schedules, bit-identity.
+chaos-smoke:
+	$(PY) -m pytest tests/test_net.py -q -m "not slow" \
+		-k "mtls or reliable or reconnect or partition or idle_timeout or tls_config or recv_timeout"
+	JAX_PLATFORMS=cpu $(PY) tools/serve.py --chaos-drill 7 --chaos-seeds 3
 
 # The status-port smoke reuses serve.py --smoke's scenario with the
 # HTTP surface armed: the run itself curls /metrics, /statusz and

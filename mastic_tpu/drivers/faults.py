@@ -45,6 +45,17 @@ party links (net/transport.py, party = the sending process) fire
 checkpoint ``net_send`` per outbound frame, so the whole action
 matrix reaches the wide-area transport too.
 
+ISSUE 14 reaches the reliable TCP/mTLS transport: the new actions
+``conn_drop`` (drop the connection now), ``partition`` (drop it and
+refuse redial for ``delay`` seconds, both directions) and
+``slow_loris`` (stall the writer mid-frame for ``delay`` seconds)
+fire at the per-frame ``on_net`` seam inside
+`net.transport.TcpTransport` — after the frame enters the replay
+buffer, so recovery exercises reconnect-and-replay, never silent
+loss — and the ``tls_handshake`` checkpoint fires in the dial/accept
+paths (kill/hang/delay a handshake).  `tools/serve.py --chaos-drill`
+composes a seeded random schedule out of exactly this vocabulary.
+
 Each process parses `MASTIC_FAULTS` itself and keeps only the rules
 addressed to its own party name, so one env var arms the whole
 session (the collector passes it through to the party processes).
@@ -61,8 +72,17 @@ from ..obs import trace as obs_trace
 from ..obs.registry import get_registry
 
 ACTIONS = ("drop", "delay", "truncate", "corrupt", "duplicate",
-           "hang", "kill")
+           "hang", "kill",
+           # ISSUE 14 network-fault actions (the reliable-transport
+           # seam, FaultInjector.on_net): a dropped connection, a
+           # partition lasting `delay` seconds both directions, and
+           # a writer that stalls mid-frame for `delay` seconds.
+           "conn_drop", "partition", "slow_loris")
 PARTIES = ("leader", "helper", "collector")
+
+# The actions only the reliable-transport seam implements (a plain
+# channel cannot recover from them; the TcpTransport reconnects).
+NET_ACTIONS = ("conn_drop", "partition", "slow_loris")
 
 # `hang` sleeps this long — far past any configured deadline, short
 # enough that an orphaned hung process eventually dies on its own.
@@ -221,6 +241,31 @@ class FaultInjector:
             time.sleep(HANG_SECONDS)
         elif rule.action == "delay":
             time.sleep(rule.delay)
+
+    def on_net(self, step: str) -> Optional[FaultRule]:
+        """The reliable-transport seam (ISSUE 14): fired by
+        `net.transport.TcpTransport` once per outbound session frame,
+        AFTER the frame enters the replay buffer and BEFORE the
+        write — so a fired `conn_drop` forces the frame through the
+        reconnect-and-replay path, which is the point.  kill/hang/
+        delay behave as at any checkpoint; the NET_ACTIONS return the
+        rule for the transport to enact (it owns the socket); the
+        frame-mutation actions are meaningless below the seq/ack
+        framing and ignored here."""
+        rule = self._match(step)
+        if rule is None:
+            return None
+        if rule.action == "kill":
+            os._exit(KILL_EXIT_CODE)
+        if rule.action == "hang":
+            time.sleep(HANG_SECONDS)
+            return None
+        if rule.action == "delay":
+            time.sleep(rule.delay)
+            return None
+        if rule.action in NET_ACTIONS:
+            return rule
+        return None
 
     def on_blob(self, step: str, blob: bytes) -> bytes:
         """Combined checkpoint + content seam for a blob-producing

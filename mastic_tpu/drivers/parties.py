@@ -513,7 +513,6 @@ def _party_loop(party: AggregatorParty, coll: Channel,
                 config: SessionConfig, injector, trace,
                 checkpoint, shaper=None) -> None:
     agg_id = party.agg_id
-    mastic = party.m
     coll.send_msg(bytes([agg_id]), "hello")
 
     if agg_id == 0:
@@ -537,7 +536,21 @@ def _party_loop(party: AggregatorParty, coll: Channel,
             config.connect_timeout, config.exchange_timeout, injector,
             shaper=shaper)
     trace("peer channel up")
+    _command_loop(party, coll, peer, config, injector, trace,
+                  checkpoint)
 
+
+def _command_loop(party: AggregatorParty, coll, peer,
+                  config: SessionConfig, injector, trace,
+                  checkpoint) -> None:
+    """The command-driven protocol engine shared by the loopback
+    spawn path (`_party_loop`) and the standalone network party
+    (`tools/party.py`): upload / round / shutdown over whatever
+    channel pair the caller built (plain or reliable, plaintext or
+    mTLS)."""
+    del injector  # faults reach this loop via `checkpoint` + channels
+    agg_id = party.agg_id
+    mastic = party.m
     while True:
         # Idle wait for the next command: bounded by the round
         # deadline, not the (shorter) exchange timeout — a collector
@@ -675,20 +688,32 @@ class ProcessCollector:
     def __init__(self, mastic: Mastic, mastic_spec: dict, ctx: bytes,
                  verify_key: bytes,
                  config: Optional[SessionConfig] = None,
-                 faults_spec: Optional[str] = None):
+                 faults_spec: Optional[str] = None,
+                 connect: Optional[dict] = None, tls=None):
         self.m = mastic
         self.spec = mastic_spec
         self.ctx = ctx
         self.verify_key = verify_key
         self.config = config or SessionConfig.from_env()
         self.faults_spec = faults_spec
+        # ISSUE 14 connect mode: parties are standalone network
+        # processes (`tools/party.py serve`) instead of spawned
+        # children — `connect` maps {"leader"/"helper"/"leader_peer"
+        # -> (host, port)}, `tls` is a net.transport.TlsConfig (this
+        # end's cert; peer names pinned per link).  Channels are
+        # reliable (sequence-numbered acked frames, reconnect-and-
+        # replay), and the verify-key-bearing party config crosses
+        # the mTLS channel instead of a local stdin pipe.
+        self.connect = connect
+        self.tls = tls
         self.injector = (
             faults_mod.FaultInjector(
                 faults_mod.parse_faults(faults_spec), "collector")
             if faults_spec is not None
             else faults_mod.injector_from_env("collector"))
         self.counters = {"timeouts": 0, "retries": 0, "respawns": 0,
-                         "quarantined": 0}
+                         "quarantined": 0, "reconnects": 0,
+                         "replayed_frames": 0}
         self.quarantine: dict = {}       # report index -> reason code
         self.num_reports = 0
         self._upload_bodies: Optional[list] = None
@@ -718,6 +743,9 @@ class ProcessCollector:
     # -- spawn / teardown / respawn --------------------------------
 
     def _spawn(self) -> None:
+        if self.connect is not None:
+            self._connect_parties()
+            return
         cfg = self.config
         self.server = socket.create_server(("127.0.0.1", 0))
         port = self.server.getsockname()[1]
@@ -792,7 +820,63 @@ class ProcessCollector:
                                "peer port")
         self.helper.send_msg(leader_port, "leader_port")
 
+    def _connect_parties(self) -> None:
+        """The ISSUE 14 deployment shape: dial each standalone party
+        over the reliable (mTLS) transport and hand it its session
+        config as the first framed message — hello comes back on the
+        same authenticated channel."""
+        from .session import reliable_connect
+
+        cfg = self.config
+        base = {"mastic": self.spec, "ctx": self.ctx.hex(),
+                "verify_key": self.verify_key.hex()}
+        if self.faults_spec is not None and self._arm_child_faults:
+            base["faults"] = self.faults_spec
+        chans: dict = {}
+        try:
+            for (agg_id, name) in ((0, "leader"), (1, "helper")):
+                (host, port) = self.connect[name]
+                chan = reliable_connect(
+                    host, int(port), name, cfg, tls=self.tls,
+                    injector=self.injector, shaper=self.shaper,
+                    deadline=Deadline(cfg.round_deadline))
+                chans[agg_id] = chan
+                party_cfg = dict(base, agg_id=agg_id)
+                if agg_id == 1:
+                    (ph, pp) = self.connect["leader_peer"]
+                    party_cfg["peer"] = [ph, int(pp)]
+                # mastic-allow: SF004 — the key-bearing config
+                # crosses the mutually-authenticated (mTLS, CA-
+                # pinned, name-checked) session channel — the
+                # sanctioned network replacement for the local
+                # stdin-pipe handoff the spawn path uses
+                chan.send_msg(json.dumps(party_cfg).encode(),
+                              "config")
+                hello = chan.recv_msg(
+                    "hello", timeout=cfg.connect_timeout)
+                if hello != bytes([agg_id]):
+                    raise SessionError(
+                        name, "hello", session_mod.KIND_PROTOCOL,
+                        f"bad hello {hello!r} from {host}:{port}")
+        except SessionError:
+            for chan in chans.values():
+                chan.close()
+            raise
+        (self.leader, self.helper) = (chans[0], chans[1])
+
+    def _fold_reliability(self) -> None:
+        """Fold the live channels' recovery counters into the
+        session-cumulative ledger before the channels are dropped
+        (teardown/respawn), so attribution survives the channels."""
+        for chan in (self.leader, self.helper):
+            if chan is not None:
+                self.counters["reconnects"] += \
+                    getattr(chan, "reconnects", 0)
+                self.counters["replayed_frames"] += \
+                    getattr(chan, "replayed_frames", 0)
+
     def _teardown(self, kill: bool = False) -> None:
+        self._fold_reliability()
         for chan in (self.leader, self.helper):
             if chan is not None:
                 chan.close()
@@ -828,6 +912,18 @@ class ProcessCollector:
             raise
         if self._upload_bodies is not None:
             self._send_upload()
+
+    def reliability_counters(self) -> dict:
+        """Session-cumulative transport recovery attribution: folded
+        counts from torn-down channels plus the live channels'."""
+        out = {"reconnects": self.counters["reconnects"],
+               "replayed_frames": self.counters["replayed_frames"]}
+        for chan in (self.leader, self.helper):
+            if chan is not None:
+                out["reconnects"] += getattr(chan, "reconnects", 0)
+                out["replayed_frames"] += \
+                    getattr(chan, "replayed_frames", 0)
+        return out
 
     def wire_bytes(self) -> dict:
         """Measured collector-side wire traffic (the Channel
@@ -1073,6 +1169,9 @@ class ProcessCollector:
         metrics.retries = self.counters["retries"]
         metrics.respawns = self.counters["respawns"]
         metrics.quarantined = self.counters["quarantined"]
+        rel = self.reliability_counters()
+        metrics.reconnects = rel["reconnects"]
+        metrics.replayed_frames = rel["replayed_frames"]
         count_round_bytes(metrics, self.m, agg_param,
                           self.num_reports)
         metrics.extra["process_separated"] = True
@@ -1140,7 +1239,8 @@ class AggregationSession:
     def __init__(self, mastic: Mastic, mastic_spec: dict, ctx: bytes,
                  verify_key: bytes,
                  config: Optional[SessionConfig] = None,
-                 faults_spec: Optional[str] = None):
+                 faults_spec: Optional[str] = None,
+                 connect: Optional[dict] = None, tls=None):
         self.m = mastic
         self.spec = mastic_spec
         self.ctx = ctx
@@ -1148,7 +1248,8 @@ class AggregationSession:
         self.config = config or SessionConfig.from_env()
         self.coll = ProcessCollector(mastic, mastic_spec, ctx,
                                      verify_key, self.config,
-                                     faults_spec)
+                                     faults_spec, connect=connect,
+                                     tls=tls)
         # [(encoded agg param, result, accept, (share0, share1))]
         self.completed: list = []
         self._replay_index = 0

@@ -61,6 +61,10 @@ class RoundMetrics:
     retries: int = 0             # idempotent-exchange / round retries
     quarantined: int = 0         # reports rejected at upload decode
     respawns: int = 0            # party pairs killed and respawned
+    # transport-recovery counters (ISSUE 14, reliable TCP/mTLS links):
+    reconnects: int = 0          # links redialed + resumed mid-session
+    replayed_frames: int = 0     # frames redelivered after reconnects
+    #                              (deduped by seq: replay ≠ duplicate)
     # structural op counts, summed over both aggregators:
     node_evals: int = 0
     aes_extend_blocks: int = 0

@@ -19,7 +19,7 @@ admission decisions serialize.
 Levers (env forms in USAGE.md "Network front"): `MASTIC_NET_MAX_BODY`,
 `MASTIC_NET_MAX_CONNS`, `MASTIC_NET_RATE`, `MASTIC_NET_BURST`,
 `MASTIC_NET_TRUST_FORWARDED`, `MASTIC_NET_MAX_TRACKED_IPS`,
-`MASTIC_NET_IO_TIMEOUT`.
+`MASTIC_NET_IO_TIMEOUT`, `MASTIC_NET_IDLE_TIMEOUT`.
 """
 
 import threading
@@ -35,6 +35,7 @@ REASON_RATE_LIMITED = "rate-limited"
 REASON_CONNS_EXHAUSTED = "connections-exhausted"
 REASON_BODY_TOO_LARGE = "body-too-large"
 REASON_INCOMPLETE_BODY = "incomplete-body"
+REASON_IDLE_TIMEOUT = "idle-timeout"
 
 
 def _env_bool(name: str, default: bool) -> bool:
@@ -63,6 +64,12 @@ class NetConfig:
     trust_forwarded: bool = False  # X-Forwarded-For as client addr
     max_tracked_ips: int = 4096    # bucket-table bound (LRU evicted)
     io_timeout: float = 30.0       # per-socket read/write deadline
+    idle_timeout: float = 30.0     # whole-request-body deadline: a
+    #                                client trickling bytes under the
+    #                                per-read io_timeout can no longer
+    #                                hold a connection slot forever —
+    #                                past this budget it sheds
+    #                                reason-coded `idle-timeout`
 
     def __post_init__(self):
         if self.max_body < 1:
@@ -73,6 +80,8 @@ class NetConfig:
             raise ValueError("max_tracked_ips must be >= 1")
         if self.rate < 0 or self.burst <= 0:
             raise ValueError("rate must be >= 0 and burst > 0")
+        if self.idle_timeout <= 0:
+            raise ValueError("idle_timeout must be > 0")
 
     @classmethod
     def from_env(cls) -> "NetConfig":
@@ -86,6 +95,7 @@ class NetConfig:
             max_tracked_ips=_env_int("MASTIC_NET_MAX_TRACKED_IPS",
                                      4096),
             io_timeout=_env_float("MASTIC_NET_IO_TIMEOUT", 30.0),
+            idle_timeout=_env_float("MASTIC_NET_IDLE_TIMEOUT", 30.0),
         )
 
 

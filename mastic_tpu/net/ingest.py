@@ -49,10 +49,16 @@ proxy's job in a real deployment — exactly where DAP puts it.
 import json
 import math
 import re
+import socket
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+
+
+class _IdleTimeout(Exception):
+    """Whole-body idle budget exhausted mid-read (shed reason
+    `idle-timeout`)."""
 
 from ..drivers import faults as faults_mod
 from ..drivers.service import ADMITTED, QUARANTINED, QUEUED, SHED
@@ -60,7 +66,8 @@ from ..obs import trace as obs_trace
 from ..obs.registry import get_registry
 from .admission import (AdmissionController, NetConfig,
                         REASON_BODY_TOO_LARGE, REASON_CONNS_EXHAUSTED,
-                        REASON_INCOMPLETE_BODY, REASON_RATE_LIMITED)
+                        REASON_IDLE_TIMEOUT, REASON_INCOMPLETE_BODY,
+                        REASON_RATE_LIMITED)
 
 MEDIA_TYPE = "application/mastic-report-bundle"
 API_VERSION = 1
@@ -192,6 +199,33 @@ class _UploadHandler(BaseHTTPRequestHandler):
             front.controller.release_connection()
             front.publish_connections()
 
+    def _read_body(self, front: "UploadFront", length: int) -> bytes:
+        """The request body under ONE whole-body idle budget
+        (`NetConfig.idle_timeout` / `MASTIC_NET_IDLE_TIMEOUT`): each
+        chunk read is still bounded by io_timeout, but the budget is
+        shared, so trickling a byte every few seconds cannot hold the
+        connection slot past the budget.  Raises `_IdleTimeout` when
+        the budget is gone with bytes still owed."""
+        from ..drivers.session import Deadline
+
+        cfg = front.cfg
+        deadline = Deadline(cfg.idle_timeout)
+        buf = bytearray()
+        while len(buf) < length:
+            rem = deadline.remaining()
+            if rem <= 0.0:
+                raise _IdleTimeout()
+            self.connection.settimeout(min(rem, cfg.io_timeout))
+            try:
+                chunk = self.rfile.read(min(length - len(buf),
+                                            1 << 16))
+            except (TimeoutError, socket.timeout):
+                raise _IdleTimeout()
+            if not chunk:
+                break   # EOF short of the promise: incomplete-body
+            buf += chunk
+        return bytes(buf)
+
     def _path_tenant(self) -> Optional[str]:
         m = _REPORTS_RE.match(self.path.split("?", 1)[0])
         return m.group(1) if m is not None else None
@@ -258,7 +292,15 @@ class _UploadHandler(BaseHTTPRequestHandler):
                           "reason": REASON_RATE_LIMITED}, retry_after)
 
         try:
-            body = self.rfile.read(length)
+            body = self._read_body(front, length)
+        except _IdleTimeout:
+            # ISSUE 14 satellite: a client trickling bytes under the
+            # per-read io_timeout used to hold a connection-ceiling
+            # slot indefinitely; the whole-body idle budget sheds it
+            # reason-coded instead (tests prove with a slow-loris).
+            front.shed(tenant, REASON_IDLE_TIMEOUT)
+            return (408, {"error": "shed",
+                          "reason": REASON_IDLE_TIMEOUT}, None)
         except OSError:
             body = b""
         if len(body) != length:

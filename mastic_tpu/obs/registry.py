@@ -75,6 +75,17 @@ DECLARED = {
          ("tenant",)),
     "mastic_session_timeouts_total":
         ("counter", "session-layer deadline expiries", ("tenant",)),
+    "mastic_session_reconnects_total":
+        ("counter", "party links redialed and resumed mid-session "
+         "(reconnect-and-replay; ReliableChannel)", ("tenant",)),
+    "mastic_frames_replayed_total":
+        ("counter", "session frames redelivered after a reconnect "
+         "(deduped by sequence number on the receiver)", ("tenant",)),
+    "mastic_tls_refusals_total":
+        ("counter", "mTLS handshakes refused, by reason code and "
+         "side (tls-wrong-ca / tls-expired-cert / "
+         "tls-hostname-mismatch / tls-plaintext / ...)",
+         ("reason", "side")),
     "mastic_faults_injected_total":
         ("counter", "MASTIC_FAULTS rules fired",
          ("action", "step")),

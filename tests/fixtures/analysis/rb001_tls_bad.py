@@ -1,0 +1,13 @@
+"""Known-bad (ISSUE 14, TLS flavor): an ssl handshake driven with no
+armed deadline (RB001) — a dialer that connects and then goes silent
+mid-handshake wedges this thread exactly like a bare recv (the
+`tls_handshake` chaos checkpoint models precisely this stall)."""
+
+
+class Listener:
+    def accept_tls(self, ctx):
+        (conn, _addr) = self.sock.accept()
+        tls = ctx.wrap_socket(conn, server_side=True,
+                              do_handshake_on_connect=False)
+        tls.do_handshake()
+        return tls

@@ -1,0 +1,15 @@
+"""Known-good twin of rb001_tls_bad: the socket carries a deadline
+before the handshake runs (the net/transport.py TcpListener
+pattern), so a stalled dialer costs the budget, never the thread."""
+
+
+class Listener:
+    def accept_tls(self, ctx, handshake_timeout: float):
+        self.sock.settimeout(handshake_timeout)
+        (conn, _addr) = self.sock.accept()
+        conn.settimeout(handshake_timeout)
+        tls = ctx.wrap_socket(conn, server_side=True,
+                              do_handshake_on_connect=False)
+        tls.settimeout(handshake_timeout)
+        tls.do_handshake()
+        return tls

@@ -1,0 +1,8 @@
+"""Known-bad (ISSUE 14, credential flavor): a TLS PRIVATE KEY's
+bytes leaving the process over a socket (SF004) — key material may
+only ever reach disk through the cert tooling's openssl calls (file
+paths, 0600), never a wire."""
+
+
+def ship_credential(sock, private_key):
+    sock.sendall(private_key)

@@ -1,0 +1,8 @@
+"""Known-good twin of sf004_key_bad: only the PATHS of credential
+files cross (the tools/party.py CLI stance) — the key bytes never
+enter this process at all, so there is nothing to leak."""
+
+
+def ship_credential_paths(sock, cert_path: str, key_path: str):
+    del key_path   # stays local: the ssl context reads it from disk
+    sock.sendall(cert_path.encode())

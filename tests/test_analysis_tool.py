@@ -343,6 +343,47 @@ NET_CASES = {
     "SF004": ("sf004_net_bad.py", "sf004_net_good.py", "secretflow"),
 }
 
+# ISSUE 14: the transport-security scope extension.  TLS-flavored
+# pairs: a deadline-less ssl handshake (RB001 — a silent dialer
+# wedges the accept thread mid-handshake) and private-key bytes
+# leaving the process (SF004 — credential egress; only file PATHS
+# may cross).  Same ride-along convention as NET_CASES.
+TLS_CASES = {
+    "RB001": ("rb001_tls_bad.py", "rb001_tls_good.py", "robustness"),
+    "SF004": ("sf004_key_bad.py", "sf004_key_good.py", "secretflow"),
+}
+
+
+@pytest.mark.parametrize("rule", sorted(TLS_CASES))
+def test_tls_bad_fixture_is_flagged(rule):
+    (bad, _good, pass_name) = TLS_CASES[rule]
+    (findings, _suppressed) = run_fixture(bad, pass_name)
+    rules_hit = {f.rule for f in findings}
+    assert rules_hit == {rule}, (
+        f"{bad} must trigger {rule} and only {rule}; got "
+        f"{[f.text() for f in findings]}")
+
+
+@pytest.mark.parametrize("rule", sorted(TLS_CASES))
+def test_tls_good_fixture_is_clean(rule):
+    (_bad, good, pass_name) = TLS_CASES[rule]
+    (findings, suppressed) = run_fixture(good, pass_name)
+    assert findings == [] and suppressed == [], (
+        f"{good} must be clean; got {[f.text() for f in findings]}")
+
+
+def test_transport_security_files_in_analyzer_scope():
+    """tools/party.py and tools/certs.py (ISSUE 14) are inside both
+    the robustness and whole-program secret-flow reporting scopes: a
+    deadline-less handshake or a key egress in the credential/party
+    tooling must be a finding, not a blind spot."""
+    from tools.analysis import robustness, secretflow
+
+    for rel in ("tools/party.py", "tools/certs.py"):
+        assert robustness.in_scope(rel), rel
+        assert secretflow.wp_in_scope(rel), rel
+
+
 
 @pytest.mark.parametrize("rule", sorted(NET_CASES))
 def test_net_bad_fixture_is_flagged(rule):

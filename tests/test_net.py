@@ -771,3 +771,407 @@ def test_upload_kill9_resume_drill():
     rec = run_upload_drill(args, tmp)
     assert rec["bit_identical"] is True
     assert rec["admitted_total"] == 6
+
+
+# -- transport security: mTLS + reconnect-and-replay (ISSUE 14) -------
+#
+# Fast tier: certs are minted once per module (openssl CLI, EC P-256,
+# ~a second), every case is socket-level — no XLA compile anywhere.
+# The full two-party TCP+mTLS collection and the seeded chaos
+# campaign run in `make chaos-smoke` (tools/serve.py --chaos-drill).
+
+from mastic_tpu.drivers.session import (SessionConfig, SessionError,
+                                        reliable_accept,
+                                        reliable_connect)
+from mastic_tpu.net.transport import TcpListener, TlsConfig
+
+RCFG = SessionConfig(connect_timeout=5.0, exchange_timeout=5.0,
+                     ack_timeout=5.0, round_deadline=30.0,
+                     shutdown_timeout=2.0, retries=2, backoff=0.05)
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    """One CA + per-party certs, plus the negative-matrix material:
+    a second CA with its own 'collector' cert (wrong CA) and an
+    expired collector cert under the pinned CA."""
+    from tools import certs as certs_mod
+
+    good = tmp_path_factory.mktemp("certs")
+    certs_mod.mint_party_set(good)
+    certs_mod.mint_party(good, "collector", days=-1,
+                         suffix="-expired")
+    rogue = tmp_path_factory.mktemp("rogue_certs")
+    certs_mod.mint_ca(rogue, ca_name="rogue-ca")
+    certs_mod.mint_party(rogue, "collector")
+    return (good, rogue)
+
+
+def _tls(d, name: str) -> TlsConfig:
+    return TlsConfig(str(d / f"{name}.pem"), str(d / f"{name}.key"),
+                     str(d / "ca.pem"))
+
+
+def _accept_outcome(listener) -> tuple:
+    """Run one accept on a thread; returns (thread, result dict) —
+    result carries either 'sock' or the refusal's kind/reason."""
+    result: dict = {}
+
+    def run():
+        try:
+            result["sock"] = listener.accept("collector", 5.0)
+        except SessionError as err:
+            result["kind"] = err.kind
+            result["reason"] = getattr(err, "reason", None)
+            result["detail"] = err.detail
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return (t, result)
+
+
+def test_mtls_session_roundtrip(certs):
+    """The positive path: mutually-authenticated reliable channels
+    carry framed messages both ways, and the per-party name pinning
+    holds (collector cert accepted by a listener expecting
+    'collector')."""
+    (good, _rogue) = certs
+    lst = TcpListener("127.0.0.1", 0,
+                      tls=_tls(good, "leader").expecting("collector"))
+    got = {}
+
+    def server():
+        ch = reliable_accept(lst, "collector", RCFG)
+        got["msg"] = ch.recv_msg("m")
+        ch.send_msg(b"pong", "m")
+        got["chan"] = ch
+
+    t = threading.Thread(target=server, daemon=True)
+    t.start()
+    ch = reliable_connect("127.0.0.1", lst.port, "leader", RCFG,
+                          tls=_tls(good, "collector"))
+    try:
+        ch.send_msg(b"ping over mTLS", "m")
+        assert ch.recv_msg("m") == b"pong"
+        t.join(timeout=5)
+        assert got["msg"] == b"ping over mTLS"
+    finally:
+        ch.close()
+        got["chan"].close()
+        lst.close()
+
+
+def test_mtls_negative_matrix(certs):
+    """Every bad credential class is refused with its reason code and
+    zero admitted frames: wrong CA, expired cert, plaintext client,
+    truncated handshake — and the refusals land in the listener's
+    ledger + the registry series."""
+    from mastic_tpu.obs.registry import get_registry
+
+    configure_registry()
+    (good, rogue) = certs
+    lst = TcpListener("127.0.0.1", 0,
+                      tls=_tls(good, "leader").expecting("collector"))
+    try:
+        import ssl as ssl_mod
+
+        from mastic_tpu.net.transport import tcp_dial as dial_fn
+
+        def dial_await_verdict(tls):
+            """Dial, then READ: TLS 1.3 lets the dialer 'finish'
+            before the listener verifies its cert, so the refusal
+            arrives as an alert on the first read — waiting for it
+            makes the server-side outcome deterministic."""
+            try:
+                s = dial_fn("127.0.0.1", lst.port, "leader", 5.0,
+                            tls=tls)
+            except SessionError:
+                return
+            try:
+                s.settimeout(5)
+                s.recv(1)
+            except (ssl_mod.SSLError, OSError):
+                pass
+            finally:
+                s.close()
+
+        # wrong CA: the dialer presents a collector cert signed by
+        # the ROGUE CA (it still pins the good CA for the server, so
+        # the refusal is the server's verdict on the client cert)
+        (t, res) = _accept_outcome(lst)
+        dial_await_verdict(TlsConfig(str(rogue / "collector.pem"),
+                                     str(rogue / "collector.key"),
+                                     str(good / "ca.pem")))
+        t.join(timeout=5)
+        assert (res["kind"], res["reason"]) == ("tls",
+                                                "tls-wrong-ca"), res
+
+        # expired collector cert under the pinned CA
+        (t, res) = _accept_outcome(lst)
+        dial_await_verdict(
+            TlsConfig(str(good / "collector-expired.pem"),
+                      str(good / "collector-expired.key"),
+                      str(good / "ca.pem")))
+        t.join(timeout=5)
+        assert res["reason"] == "tls-expired-cert", res
+
+        # plaintext client against the TLS listener
+        (t, res) = _accept_outcome(lst)
+        raw = socket.create_connection(("127.0.0.1", lst.port),
+                                       timeout=5)
+        raw.sendall(b"\x02plaintext session frame")
+        t.join(timeout=5)
+        raw.close()
+        assert res["reason"] == "tls-plaintext", res
+
+        # truncated handshake: a TLS record header, then EOF
+        (t, res) = _accept_outcome(lst)
+        raw = socket.create_connection(("127.0.0.1", lst.port),
+                                       timeout=5)
+        raw.sendall(b"\x16\x03\x01\x00\x80")
+        raw.close()
+        t.join(timeout=5)
+        assert res["reason"] == "tls-truncated-handshake", res
+
+        assert lst.refusals == {"tls-wrong-ca": 1,
+                                "tls-expired-cert": 1,
+                                "tls-plaintext": 1,
+                                "tls-truncated-handshake": 1}
+        reg = get_registry()
+        for reason in lst.refusals:
+            assert reg.counter("mastic_tls_refusals_total",
+                               reason=reason,
+                               side="server").value() == 1
+    finally:
+        lst.close()
+
+
+def test_mtls_hostname_mismatch_refused(certs):
+    """CA-valid credential, wrong NAME: the dialer expects 'helper'
+    but the listener presents the leader cert — refused client-side
+    with the hostname reason; the listener sees the alert."""
+    (good, _rogue) = certs
+    lst = TcpListener("127.0.0.1", 0,
+                      tls=_tls(good, "leader").expecting("collector"))
+    try:
+        (t, res) = _accept_outcome(lst)
+        with pytest.raises(SessionError) as ei:
+            reliable_connect("127.0.0.1", lst.port, "helper", RCFG,
+                             tls=_tls(good, "collector"))
+        assert ei.value.kind == "tls"
+        assert getattr(ei.value, "reason", None) \
+            == "tls-hostname-mismatch"
+        t.join(timeout=5)
+        assert res.get("reason") == "tls-peer-refused", res
+    finally:
+        lst.close()
+
+
+def test_reliable_reconnect_and_replay_exactly_once():
+    """A connection killed between (and inside) exchanges redials and
+    resumes from the last acked frame: every payload arrives exactly
+    once, reconnects/replayed_frames are attributed."""
+    lst = TcpListener("127.0.0.1", 0)
+    got = {}
+
+    def server():
+        ch = reliable_accept(lst, "collector", RCFG)
+        got["msgs"] = [ch.recv_msg("s") for _ in range(3)]
+        ch.send_msg(b"done", "s")
+        got["chan"] = ch
+
+    t = threading.Thread(target=server, daemon=True)
+    t.start()
+    ch = reliable_connect("127.0.0.1", lst.port, "leader", RCFG)
+    try:
+        ch.send_msg(b"one", "s")
+        ch.tp.kill_socket()          # drop between frames
+        ch.send_msg(b"two", "s")
+        ch.tp.kill_socket()          # and again
+        ch.send_msg(b"three", "s")
+        assert ch.recv_msg("s") == b"done"
+        t.join(timeout=5)
+        assert got["msgs"] == [b"one", b"two", b"three"]
+        assert ch.reconnects == 2
+        assert ch.replayed_frames >= 1
+    finally:
+        ch.close()
+        got["chan"].close()
+        lst.close()
+
+
+def test_injected_conn_drop_recovers_and_traces():
+    """The on_net fault seam: an injected conn_drop fires AFTER the
+    frame enters the replay buffer, so recovery runs reconnect-and-
+    replay; the trace carries a `session_reconnect` event (distinct
+    from `session_retry`) with the replay attribution, and the
+    registry counts the reconnect."""
+    from mastic_tpu.obs import trace as trace_mod
+    from mastic_tpu.obs.registry import get_registry
+
+    configure_registry()
+    tracer = trace_mod.configure()
+    inj = faults.FaultInjector(
+        faults.parse_faults("conn_drop:party=collector:step=upload"),
+        "collector")
+    lst = TcpListener("127.0.0.1", 0)
+    got = {}
+
+    def server():
+        ch = reliable_accept(lst, "collector", RCFG)
+        got["msg"] = ch.recv_msg("upload")
+        got["chan"] = ch
+
+    t = threading.Thread(target=server, daemon=True)
+    t.start()
+    ch = reliable_connect("127.0.0.1", lst.port, "leader", RCFG)
+    ch.tp.injector = inj
+    try:
+        ch.send_msg(b"report body", "upload")
+        t.join(timeout=5)
+        assert got["msg"] == b"report body"
+        assert inj.rules[0].fired
+        assert ch.reconnects == 1 and ch.replayed_frames >= 1
+        events = [ev for sp in tracer.spans() for ev in [sp]
+                  if sp.name == "session_reconnect"]
+        assert events, [sp.name for sp in tracer.spans()]
+        attrs = events[-1].attrs
+        assert attrs["frames_replayed"] >= 1
+        assert attrs["redials"] == 1
+        assert not [sp for sp in tracer.spans()
+                    if sp.name == "session_retry"]
+        # Both ends of the link count their own recovery (the server
+        # thread re-accepted), so the process-wide series sees >= 1.
+        assert get_registry().counter(
+            "mastic_session_reconnects_total",
+            tenant="").value() >= 1
+        assert get_registry().counter(
+            "mastic_frames_replayed_total", tenant="").value() >= 1
+    finally:
+        ch.close()
+        got["chan"].close()
+        lst.close()
+        trace_mod.configure()
+
+
+def test_injected_partition_heals_within_deadline():
+    """A partition (both directions down for delay seconds) heals:
+    the redial ladder backs off through the partition window and the
+    exchange completes, attributed as a reconnect."""
+    inj = faults.FaultInjector(
+        faults.parse_faults(
+            "partition:party=collector:step=agg_param:delay=0.3"),
+        "collector")
+    lst = TcpListener("127.0.0.1", 0)
+    got = {}
+
+    def server():
+        ch = reliable_accept(lst, "collector", RCFG)
+        got["msg"] = ch.recv_msg("agg_param")
+        got["chan"] = ch
+
+    t = threading.Thread(target=server, daemon=True)
+    t.start()
+    ch = reliable_connect("127.0.0.1", lst.port, "leader", RCFG)
+    ch.tp.injector = inj
+    try:
+        t0 = time.monotonic()
+        ch.send_msg(b"round command", "agg_param")
+        t.join(timeout=10)
+        assert got["msg"] == b"round command"
+        assert time.monotonic() - t0 >= 0.3   # waited out the cut
+        assert ch.reconnects == 1
+    finally:
+        ch.close()
+        got["chan"].close()
+        lst.close()
+
+
+def test_recv_timeout_does_not_redial():
+    """A slow peer is slow, not gone: a recv timeout surfaces as an
+    attributed SessionError without burning a reconnect."""
+    lst = TcpListener("127.0.0.1", 0)
+    srv = {}
+
+    def server():
+        srv["chan"] = reliable_accept(lst, "collector", RCFG)
+
+    t = threading.Thread(target=server, daemon=True)
+    t.start()
+    ch = reliable_connect("127.0.0.1", lst.port, "leader", RCFG)
+    t.join(timeout=5)
+    try:
+        with pytest.raises(SessionError) as ei:
+            ch.recv_msg("agg_share", timeout=0.2)
+        assert ei.value.kind == "timeout"
+        assert ch.reconnects == 0
+    finally:
+        ch.close()
+        srv["chan"].close()
+        lst.close()
+
+
+def test_idle_timeout_sheds_slow_loris():
+    """ISSUE 14 satellite: a slow-loris client (bytes trickling under
+    the per-read io_timeout) is shed at the whole-body idle budget
+    with reason `idle-timeout` — the connection slot comes back, the
+    ledger and the 408 are explicit."""
+    configure_registry()
+    (svc, m) = make_service()
+    front = UploadFront(
+        svc, config=NetConfig(idle_timeout=0.3, io_timeout=5.0)
+    ).start()
+    try:
+        blob = blobs_for(m, 1)[0]
+        sock = socket.create_connection(("127.0.0.1", front.port),
+                                        timeout=10)
+        try:
+            head = (f"PUT /v1/tenants/count/reports HTTP/1.1\r\n"
+                    f"Host: t\r\nContent-Type: {MEDIA_TYPE}\r\n"
+                    f"Content-Length: {len(blob) + 64}\r\n\r\n"
+                    ).encode()
+            sock.sendall(head + blob[:8])   # then stall, holding on
+            t0 = time.monotonic()
+            chunks = []
+            while True:
+                data = sock.recv(4096)
+                if not data:
+                    break
+                chunks.append(data)
+            resp = b"".join(chunks).decode()
+        finally:
+            sock.close()
+        waited = time.monotonic() - t0
+        assert " 408 " in resp.splitlines()[0], resp
+        assert "idle-timeout" in resp
+        assert 0.2 <= waited < 5.0   # the budget, not io_timeout
+        c = svc.metrics()["tenants"]["count"]["counters"]
+        assert c["shed_reasons"] == {"idle-timeout": 1}
+        assert c["admitted"] == 0
+        # The slot is free again: a well-behaved upload admits.
+        assert put(front.port, "/v1/tenants/count/reports",
+                   blob)[0] == 201
+    finally:
+        front.stop()
+
+
+def test_tls_config_env_parsing(monkeypatch, certs):
+    """Partial MASTIC_NET_TLS_* is an error (silent plaintext when
+    the operator meant TLS would be the worst outcome); a full set
+    parses; an empty set means unarmed."""
+    (good, _rogue) = certs
+    for var in ("MASTIC_NET_TLS_CERT", "MASTIC_NET_TLS_KEY",
+                "MASTIC_NET_TLS_CA", "MASTIC_NET_TLS_NAME"):
+        monkeypatch.delenv(var, raising=False)
+    assert TlsConfig.from_env() is None
+    monkeypatch.setenv("MASTIC_NET_TLS_CERT",
+                       str(good / "leader.pem"))
+    with pytest.raises(ValueError):
+        TlsConfig.from_env()
+    monkeypatch.setenv("MASTIC_NET_TLS_KEY",
+                       str(good / "leader.key"))
+    monkeypatch.setenv("MASTIC_NET_TLS_CA", str(good / "ca.pem"))
+    tls = TlsConfig.from_env()
+    assert tls.ca_file == str(good / "ca.pem")
+    assert tls.peer_name is None
+    assert tls.expecting("collector").peer_name == "collector"

@@ -63,7 +63,8 @@ from .core import Finding, root_name
 PASS_NAME = "robustness"
 
 RULES = {
-    "RB001": "blocking socket read without a deadline",
+    "RB001": "blocking socket read (or ssl handshake) without a "
+             "deadline",
     "RB002": "except block swallows the error without re-raise or "
              "structured report",
     "RB003": "direct device_put in drivers/ bypasses "
@@ -76,10 +77,18 @@ RULES = {
 SCOPE_PREFIXES = ("mastic_tpu/drivers/", "mastic_tpu/net/")
 
 # The service/load CLIs live in tools/ but own the same
-# long-lived-loop failure modes the drivers do.
-EXTRA_FILES = ("tools/serve.py", "tools/loadgen.py")
+# long-lived-loop failure modes the drivers do; the standalone
+# network party and the cert minter (ISSUE 14) own sockets and TLS
+# handshakes at the same exposure.
+EXTRA_FILES = ("tools/serve.py", "tools/loadgen.py",
+               "tools/party.py", "tools/certs.py")
 
-_BLOCKING_READS = {"accept", "recv", "recv_into", "makefile"}
+# `do_handshake` (ISSUE 14): an ssl handshake on a socket with no
+# armed timeout blocks on a silent peer exactly like a bare recv —
+# the tls_handshake chaos checkpoint exists because this stall is a
+# real attack surface.
+_BLOCKING_READS = {"accept", "recv", "recv_into", "makefile",
+                   "do_handshake"}
 _CONNECT_FNS = {"create_connection"}
 
 

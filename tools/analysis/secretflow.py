@@ -85,7 +85,10 @@ SCOPE_FILES = ("mastic_tpu/vidpf.py", "mastic_tpu/mastic.py",
 _SECRET_PARAMS = {"seed", "seeds", "key", "keys", "rand", "alpha",
                   "alphas", "beta", "betas", "block", "measurement",
                   "measurements", "input_share", "input_shares",
-                  "weight", "verify_key"}
+                  "weight", "verify_key",
+                  # ISSUE 14 (mTLS credential handling): TLS private
+                  # keys are secrets whether or not the protocol is
+                  "private_key", "key_pem", "private_keys"}
 _SECRET_SUFFIXES = ("_seed", "_seeds", "_key", "_keys", "_rand",
                     "_rands")
 _SECRET_ATTRS = {"seed", "ctrl", "w", "round_keys"}
@@ -277,8 +280,12 @@ def check(info) -> list:
 # exposure — they must be PROVEN secret-free, not assumed.
 WP_SCOPE_PREFIXES = ("mastic_tpu/drivers/", "mastic_tpu/obs/",
                      "mastic_tpu/net/")
+# tools/party.py + tools/certs.py since ISSUE 14: the standalone
+# network party holds the verify key it received over mTLS, and the
+# cert tooling orbits PRIVATE KEYS — egress there is the worst case.
 WP_SCOPE_FILES = ("tools/serve.py", "tools/loadgen.py",
-                  "mastic_tpu/metrics.py")
+                  "mastic_tpu/metrics.py", "tools/party.py",
+                  "tools/certs.py")
 
 # The service plane adds key-binding material to the secret attrs.
 _WP_SECRET_ATTRS = _SECRET_ATTRS | {"verify_key"}

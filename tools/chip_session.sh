@@ -109,6 +109,17 @@ run serve-load python tools/loadgen.py --clients 1000000 \
     --duration 30 --rate 600 --workers 8 --slo-p99-ms 250
 run parties-wan python bench.py --parties-wan
 
+# 6f. Survivable multi-host parties on the chip host (ISSUE 14):
+# parties-tcp runs the seeded chaos campaign — standalone TCP+mTLS
+# party processes (tools/party.py), reconnect-and-replay under
+# injected conn_drop/partition/tls_handshake/slow_loris, bit-identity
+# vs the loopback path — with chip-speed party compute; chaos-soak
+# widens it to eight seeds for an unattended soak of the recovery
+# machinery (every run's JSON line stamps reconnects/replayed_frames).
+run parties-tcp python tools/serve.py --chaos-drill 7 --chaos-seeds 3
+run chaos-soak python tools/serve.py --chaos-drill 100 \
+    --chaos-seeds 8
+
 # 6c. On-chip AOT bake + trace-free load cycle (ISSUE 9,
 # drivers/artifacts.py): bake the cold-start family on the chip,
 # then bench.py --cold-start reuses the store (MASTIC_ARTIFACT_DIR

@@ -294,6 +294,20 @@ def main() -> None:
     parser.add_argument("--soak", type=float, default=0.0,
                         help="unattended soak for SECONDS "
                              "(chip-session cell)")
+    parser.add_argument("--chaos-drill", type=int, default=None,
+                        metavar="SEED",
+                        help="seeded network-chaos campaign (ISSUE "
+                             "14): full two-party collections over "
+                             "TCP+mTLS standalone parties "
+                             "(tools/party.py) under a randomized "
+                             "conn_drop/partition/tls_handshake/"
+                             "slow_loris schedule — bit-identity vs "
+                             "the loopback path, every injected "
+                             "fault recovered and attributed "
+                             "(USAGE.md 'Transport security')")
+    parser.add_argument("--chaos-seeds", type=int, default=3,
+                        help="distinct chaos schedules to run, "
+                             "seeds SEED..SEED+N-1 (default 3)")
     parser.add_argument("--status-port", type=int, default=None,
                         help="serve /metrics, /statusz and /varz on "
                              "127.0.0.1:PORT (0 = ephemeral; USAGE.md "
@@ -369,6 +383,9 @@ def main() -> None:
         return
     if args.overlap_drill:
         run_overlap_drill(args)
+        return
+    if args.chaos_drill is not None:
+        run_chaos_drill(args)
         return
 
     from mastic_tpu.drivers.service import (CollectorService,
@@ -602,6 +619,269 @@ def run_overlap_drill(args) -> None:
         "burst_admitted": landed,
         "burst_queue_shed": shed_at_queue,
         "kill9_resume_bit_identical": True,
+        "wall_seconds": round(time.time() - t_start, 1),
+        "ok": True,
+    }
+    line = json.dumps(out)
+    print(line, flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+
+
+def run_chaos_drill(args) -> None:
+    """The seeded network-chaos campaign (`--chaos-drill SEED`):
+
+    1. loopback baseline — the spawn-path AggregationSession walks a
+       full heavy-hitters collection; its per-round results, accept
+       masks and raw share bytes are the bit-identity target;
+    2. TCP+mTLS pair — two standalone `tools/party.py serve`
+       processes on distinct listen addresses (certs minted by
+       tools/certs.py), collector in connect mode; must reproduce
+       the loopback collection byte for byte;
+    3. chaos runs — for each of `--chaos-seeds` seeds, a fresh party
+       pair runs the same collection under a seeded random schedule
+       of conn_drop / partition / slow_loris / tls_handshake-delay
+       faults.  Every run must be bit-identical, every injected rule
+       must have fired, every recovery must be attributed
+       (RoundMetrics.reconnects / replayed_frames and the
+       mastic_session_reconnects_total / mastic_frames_replayed_total
+       series nonzero), and zero uploads lost or duplicated
+       (quarantine empty, accept masks identical).
+    """
+    import random as random_mod
+    import subprocess
+    import tempfile
+
+    import numpy as np
+
+    from mastic_tpu.drivers.parties import AggregationSession
+    from mastic_tpu.drivers.session import SessionConfig
+    from mastic_tpu.net.transport import TlsConfig
+    from mastic_tpu.obs.registry import get_registry
+    from tools import certs as certs_mod
+
+    t_start = time.time()
+    bits = 2
+    from mastic_tpu.mastic import MasticCount
+
+    m = MasticCount(bits)
+    spec = {"class": "MasticCount", "args": [bits]}
+    ctx = b"chaos drill"
+    rng = np.random.default_rng(args.seed)
+    vk = bytes(rng.integers(0, 256, m.VERIFY_KEY_SIZE, dtype="uint8"))
+    reports = build_reports(m, ctx, rng, [0, 0, 3, 3], bits)
+    thresholds = {"default": 2}
+    cfg = SessionConfig(connect_timeout=30.0, exchange_timeout=240.0,
+                        ack_timeout=60.0, round_deadline=600.0,
+                        shutdown_timeout=5.0, retries=2, backoff=0.2)
+
+    tmp = tempfile.mkdtemp(prefix="mastic_chaos_")
+    certdir = certs_mod.mint_party_set(os.path.join(tmp, "certs"))
+    tls = TlsConfig(str(certdir / "collector.pem"),
+                    str(certdir / "collector.key"),
+                    str(certdir / "ca.pem"))
+
+    def walk(sess):
+        """Full threshold-pruned heavy-hitters collection; returns
+        (hitters, per-round records, metrics records)."""
+        from mastic_tpu.drivers.heavy_hitters import get_threshold
+
+        rounds = []
+        metrics: list = []
+        try:
+            sess.upload(reports)
+            prefixes = [(False,), (True,)]
+            for level in range(bits):
+                param = (level, tuple(prefixes), level == 0)
+                (result, accept, shares) = sess.round(
+                    param, metrics_out=metrics)
+                rounds.append((list(result),
+                               [bool(x) for x in accept], shares))
+                survivors = [p for (p, c) in zip(prefixes, result)
+                             if c >= get_threshold(thresholds, p)]
+                prefixes = (survivors if level == bits - 1 else
+                            [p + (b,) for p in survivors
+                             for b in (False, True)])
+        finally:
+            sess.close()
+        return (sorted(prefixes), rounds, metrics)
+
+    def spawn_pair(tag):
+        """Two standalone mTLS parties on distinct listen
+        addresses; returns (procs, connect map)."""
+        pdir = os.path.join(tmp, tag)
+        os.makedirs(pdir, exist_ok=True)
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        env.pop("MASTIC_FAULTS", None)
+        procs = []
+        for (name, extra) in (("leader",
+                               ["--peer-listen", "127.0.0.1:0"]),
+                              ("helper", [])):
+            procs.append(subprocess.Popen(
+                [sys.executable,
+                 os.path.join(os.path.dirname(
+                     os.path.abspath(__file__)), "party.py"),
+                 "serve", "--listen", "127.0.0.1:0",
+                 "--tls-cert", str(certdir / f"{name}.pem"),
+                 "--tls-key", str(certdir / f"{name}.key"),
+                 "--tls-ca", str(certdir / "ca.pem"),
+                 "--port-file", os.path.join(pdir, f"{name}.ports")]
+                + extra,
+                env=env, stdout=sys.stderr, stderr=sys.stderr))
+
+        def ports(name):
+            path = os.path.join(pdir, f"{name}.ports")
+            give_up = time.monotonic() + 120.0
+            while time.monotonic() < give_up:
+                try:
+                    with open(path) as f:
+                        return json.load(f)
+                except (FileNotFoundError, ValueError):
+                    time.sleep(0.1)
+            fail(f"party {name} never published its ports ({tag})")
+
+        (lp, hp) = (ports("leader"), ports("helper"))
+        connect = {"leader": ("127.0.0.1", lp["listen"]),
+                   "helper": ("127.0.0.1", hp["listen"]),
+                   "leader_peer": ("127.0.0.1", lp["peer_listen"])}
+        return (procs, connect)
+
+    def reap(procs):
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+
+    def chaos_schedule(seed):
+        """A seeded random fault schedule, all rules addressed to the
+        collector (whose injector we can audit after the run): at
+        least one hard drop (so reconnect-and-replay provably runs),
+        a guaranteed-firing tls_handshake delay, and a random tail of
+        partitions / extra drops / stalled writers."""
+        r = random_mod.Random(seed)
+        rules = [
+            f"conn_drop:party=collector:step=upload"
+            f":nth={r.randint(1, 2)}",
+            f"delay:party=collector:step=tls_handshake:nth=1"
+            f":delay={r.uniform(0.1, 0.3):.2f}",
+        ]
+        extras = r.randint(1, 2)
+        for _ in range(extras):
+            pick = r.choice(("partition", "conn_drop", "slow_loris"))
+            if pick == "partition":
+                rules.append(
+                    f"partition:party=collector:step=agg_param"
+                    f":nth={r.randint(1, 4)}"
+                    f":delay={r.uniform(0.3, 0.8):.2f}")
+            elif pick == "conn_drop":
+                rules.append(
+                    f"conn_drop:party=collector:step=agg_param"
+                    f":nth={r.randint(1, 4)}")
+            else:
+                rules.append(
+                    f"slow_loris:party=collector:step=upload"
+                    f":nth={r.randint(1, 2)}"
+                    f":delay={r.uniform(0.2, 0.5):.2f}")
+        # Distinct (step, nth) per rule — two rules on one occurrence
+        # would leave the later one unfired and the audit ambiguous.
+        seen = set()
+        out = []
+        for rule in rules:
+            key = tuple(sorted(
+                kv for kv in rule.split(":")
+                if kv.startswith(("step=", "nth="))))
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(rule)
+        return ";".join(out)
+
+    # 1. loopback baseline (the spawn path).
+    base = walk(AggregationSession(m, spec, ctx, vk, config=cfg))
+    print(f"chaos: loopback baseline hitters={base[0]}",
+          file=sys.stderr, flush=True)
+
+    # 2. undisturbed TCP+mTLS pair on distinct listen addresses.
+    (procs, connect) = spawn_pair("undisturbed")
+    try:
+        tcp = walk(AggregationSession(m, spec, ctx, vk, config=cfg,
+                                      connect=connect, tls=tls))
+    finally:
+        reap(procs)
+    if tcp[:2] != base[:2]:
+        fail(f"TCP+mTLS pair diverged from loopback: {tcp[:2]} != "
+             f"{base[:2]}")
+    print("chaos: TCP+mTLS pair bit-identical to loopback",
+          file=sys.stderr, flush=True)
+
+    # 3. the seeded chaos campaign.
+    seeds = list(range(args.chaos_drill,
+                       args.chaos_drill + args.chaos_seeds))
+    runs = []
+    for seed in seeds:
+        spec_str = chaos_schedule(seed)
+        drops = sum(1 for r in spec_str.split(";")
+                    if r.startswith(("conn_drop", "partition")))
+        (procs, connect) = spawn_pair(f"seed{seed}")
+        sess = AggregationSession(m, spec, ctx, vk, config=cfg,
+                                  faults_spec=spec_str,
+                                  connect=connect, tls=tls)
+        try:
+            chaos = walk(sess)
+            rel = sess.coll.reliability_counters()
+            unfired = [f"{r.action}:{r.step}:nth={r.nth}"
+                       for r in sess.coll.injector.rules
+                       if not r.fired]
+            quarantined = dict(sess.coll.quarantine)
+        finally:
+            reap(procs)
+        if chaos[:2] != base[:2]:
+            fail(f"seed {seed}: chaos run diverged: {chaos[:2]} != "
+                 f"{base[:2]}")
+        if unfired:
+            fail(f"seed {seed}: injected rules never fired: "
+                 f"{unfired} (schedule {spec_str})")
+        if rel["reconnects"] < drops:
+            fail(f"seed {seed}: {drops} drops/partitions injected "
+                 f"but only {rel['reconnects']} reconnects counted")
+        if rel["replayed_frames"] < 1:
+            fail(f"seed {seed}: no frames replayed despite "
+                 f"{drops} drops — recovery path not exercised")
+        if quarantined:
+            fail(f"seed {seed}: uploads quarantined under chaos: "
+                 f"{quarantined}")
+        last = chaos[2][-1]
+        if last.reconnects < drops or last.replayed_frames < 1:
+            fail(f"seed {seed}: RoundMetrics missing recovery "
+                 f"attribution: reconnects={last.reconnects} "
+                 f"replayed_frames={last.replayed_frames}")
+        print(f"chaos: seed {seed} ok — schedule [{spec_str}] "
+              f"reconnects={rel['reconnects']} "
+              f"replayed={rel['replayed_frames']}",
+              file=sys.stderr, flush=True)
+        runs.append({"seed": seed, "schedule": spec_str,
+                     "reconnects": rel["reconnects"],
+                     "replayed_frames": rel["replayed_frames"]})
+
+    reg = get_registry()
+    if not reg.counter("mastic_session_reconnects_total",
+                       tenant="").value():
+        fail("mastic_session_reconnects_total never incremented")
+    if not reg.counter("mastic_frames_replayed_total",
+                       tenant="").value():
+        fail("mastic_frames_replayed_total never incremented")
+
+    out = {
+        "mode": "chaos-drill",
+        "seeds": seeds,
+        "tcp_mtls_bit_identical": True,
+        "runs": runs,
+        "hitters": [[bool(b) for b in p] for p in base[0]],
         "wall_seconds": round(time.time() - t_start, 1),
         "ok": True,
     }
