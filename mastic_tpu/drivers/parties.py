@@ -515,29 +515,37 @@ def _party_loop(party: AggregatorParty, coll: Channel,
     agg_id = party.agg_id
     coll.send_msg(bytes([agg_id]), "hello")
 
-    if agg_id == 0:
-        lst = socket.create_server(("127.0.0.1", 0))
-        coll.send_msg(lst.getsockname()[1].to_bytes(2, "little"),
-                      "leader_port")
-        trace("listening for helper")
-        peer = session_mod.accept(lst, "helper",
-                                  config.connect_timeout,
-                                  config.exchange_timeout, injector,
-                                  shaper=shaper)
-        lst.close()
-    else:
-        port_msg = coll.recv_msg("leader_port")
-        if port_msg is None or len(port_msg) != 2:
-            raise SessionError("collector", "leader_port",
-                               session_mod.KIND_CLOSED,
-                               "no leader port from collector")
-        peer = session_mod.connect(
-            "127.0.0.1", int.from_bytes(port_msg, "little"), "leader",
-            config.connect_timeout, config.exchange_timeout, injector,
-            shaper=shaper)
-    trace("peer channel up")
-    _command_loop(party, coll, peer, config, injector, trace,
-                  checkpoint)
+    peer = None
+    try:
+        if agg_id == 0:
+            lst = socket.create_server(("127.0.0.1", 0))
+            try:
+                coll.send_msg(
+                    lst.getsockname()[1].to_bytes(2, "little"),
+                    "leader_port")
+                trace("listening for helper")
+                peer = session_mod.accept(lst, "helper",
+                                          config.connect_timeout,
+                                          config.exchange_timeout,
+                                          injector, shaper=shaper)
+            finally:
+                lst.close()
+        else:
+            port_msg = coll.recv_msg("leader_port")
+            if port_msg is None or len(port_msg) != 2:
+                raise SessionError("collector", "leader_port",
+                                   session_mod.KIND_CLOSED,
+                                   "no leader port from collector")
+            peer = session_mod.connect(
+                "127.0.0.1", int.from_bytes(port_msg, "little"),
+                "leader", config.connect_timeout,
+                config.exchange_timeout, injector, shaper=shaper)
+        trace("peer channel up")
+        _command_loop(party, coll, peer, config, injector, trace,
+                      checkpoint)
+    finally:
+        if peer is not None:
+            peer.close()
 
 
 def _command_loop(party: AggregatorParty, coll, peer,
@@ -794,20 +802,31 @@ class ProcessCollector:
                     self.server, "party", cfg.connect_timeout,
                     cfg.exchange_timeout, self.injector,
                     shaper=self.shaper)
-                hello = chan.recv_msg("hello")
             except SessionError as err:
                 raise self._attributed(err)
-            if hello is None or len(hello) != 1 \
-                    or hello[0] not in (0, 1):
-                raise SessionError(
-                    "party", "hello", session_mod.KIND_MALFORMED,
-                    f"bad hello {hello!r}")
-            if hello[0] in chans:
-                raise SessionError(
-                    "leader" if hello[0] == 0 else "helper", "hello",
-                    session_mod.KIND_PROTOCOL, "duplicate hello")
-            chan.remote = "leader" if hello[0] == 0 else "helper"
-            chans[hello[0]] = chan
+            # The accepted channel closes on every raise out of the
+            # hello exchange (RL001) — a malformed peer must not
+            # strand its fd on the runner.
+            try:
+                try:
+                    hello = chan.recv_msg("hello")
+                except SessionError as err:
+                    raise self._attributed(err)
+                if hello is None or len(hello) != 1 \
+                        or hello[0] not in (0, 1):
+                    raise SessionError(
+                        "party", "hello", session_mod.KIND_MALFORMED,
+                        f"bad hello {hello!r}")
+                if hello[0] in chans:
+                    raise SessionError(
+                        "leader" if hello[0] == 0 else "helper",
+                        "hello", session_mod.KIND_PROTOCOL,
+                        "duplicate hello")
+                chan.remote = "leader" if hello[0] == 0 else "helper"
+                chans[hello[0]] = chan
+            except BaseException:
+                chan.close()
+                raise
         (self.leader, self.helper) = (chans[0], chans[1])
         try:
             leader_port = self.leader.recv_msg("leader_port")

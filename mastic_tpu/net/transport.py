@@ -381,25 +381,36 @@ class TcpListener:
                                  f"accept failed: {exc}")
         if self._ctx is None:
             return sock
-        sock.settimeout(handshake_timeout)
         try:
+            sock.settimeout(handshake_timeout)
             tls_sock = self._ctx.wrap_socket(sock, server_side=True)
         except (ssl.SSLError, OSError, EOFError) as exc:
             sock.close()
             err = _refusal(remote, exc, "server")
             self._note_refusal(err.reason)
             raise err
-        names = _cert_names(tls_sock.getpeercert() or {})
-        expected = self.tls.peer_name
-        if expected is not None and expected not in names:
+        except BaseException:
+            # Anything outside the reason-coded tuple (an injector
+            # fault, KeyboardInterrupt mid-handshake) must not strand
+            # the accepted fd on the floor (RL001).
+            sock.close()
+            raise
+        try:
+            names = _cert_names(tls_sock.getpeercert() or {})
+            expected = self.tls.peer_name
+            if expected is not None and expected not in names:
+                err = _session_error(
+                    remote, "tls_handshake", session_mod.KIND_TLS,
+                    f"{TLS_NAME_MISMATCH}: peer cert names {names} do "
+                    f"not include expected {expected!r}")
+                err.reason = TLS_NAME_MISMATCH
+                self._note_refusal(TLS_NAME_MISMATCH)
+                raise err
+        except BaseException:
+            # The refusal (or any surprise past the handshake) closes
+            # the wrapped socket — wrap_socket owns `sock` from here.
             tls_sock.close()
-            err = _session_error(
-                remote, "tls_handshake", session_mod.KIND_TLS,
-                f"{TLS_NAME_MISMATCH}: peer cert names {names} do "
-                f"not include expected {expected!r}")
-            err.reason = TLS_NAME_MISMATCH
-            self._note_refusal(TLS_NAME_MISMATCH)
-            raise err
+            raise
         return tls_sock
 
 

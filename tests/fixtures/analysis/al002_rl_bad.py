@@ -1,0 +1,13 @@
+"""Known-bad: stale suppression on the CFG-era rules (AL002) — the
+leak this allow once excused was fixed, but the allow stayed behind."""
+
+
+def fine(make):
+    sock = make()
+    try:
+        sock.settimeout(5)
+        return sock
+    except BaseException:
+        # mastic-allow: RL001, EV001 — historical leak, since fixed
+        sock.close()
+        raise

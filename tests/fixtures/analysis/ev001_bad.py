@@ -1,0 +1,8 @@
+"""EV001: bare recv in a non-blocking context — nothing proved the
+fd readable, so the call either blocks the loop or raises
+BlockingIOError."""
+
+
+def pump(sock):
+    sock.setblocking(False)
+    return sock.recv(4096)

@@ -1,0 +1,14 @@
+"""EV001 clean: the recv lives in a selector callback — the loop
+dispatched it only after select() proved the fd ready."""
+import selectors
+
+
+def on_readable(sock):
+    return sock.recv(4096)
+
+
+def loop(sel, sock):
+    sel.register(sock, selectors.EVENT_READ, on_readable)
+    while True:
+        for (key, _mask) in sel.select():
+            key.data(key.fileobj)

@@ -1,0 +1,9 @@
+"""EV002: a send loop with no writability registration — a slow
+reader turns it into a spin (non-blocking) or a stall (blocking)."""
+
+
+def flush(sock, payload):
+    sock.setblocking(False)
+    while payload:
+        sent = sock.send(payload)
+        payload = payload[sent:]

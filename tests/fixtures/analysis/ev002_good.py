@@ -1,0 +1,9 @@
+"""EV002 clean: the loop waits for writability before each send."""
+
+
+def flush(sel, sock, payload):
+    sock.setblocking(False)
+    while payload:
+        sel.select(0)
+        sent = sock.send(payload)
+        payload = payload[sent:]

@@ -1,0 +1,12 @@
+"""EV003: blocking sleep under a held lock in a non-blocking
+context — the loop stalls AND every lock waiter queues behind it."""
+import threading
+import time
+
+MU = threading.Lock()
+
+
+def drain(sock):
+    sock.setblocking(False)
+    with MU:
+        time.sleep(0.1)

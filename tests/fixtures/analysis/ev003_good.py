@@ -1,0 +1,11 @@
+"""EV003 clean: the only wait under the lock carries a timeout."""
+import queue
+import threading
+
+MU = threading.Lock()
+
+
+def drain(sock, q):
+    sock.setblocking(False)
+    with MU:
+        return q.get(timeout=0.05)

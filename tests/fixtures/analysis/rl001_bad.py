@@ -1,0 +1,9 @@
+"""RL001: the configure step between acquisition and return can
+raise, and nothing closes the socket on that path."""
+import socket
+
+
+def dial(host, port):
+    sock = socket.create_connection((host, port))
+    sock.settimeout(5.0)
+    return sock

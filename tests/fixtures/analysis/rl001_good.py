@@ -1,0 +1,13 @@
+"""RL001 clean: every raise between acquisition and handoff closes
+the socket before propagating."""
+import socket
+
+
+def dial(host, port):
+    sock = socket.create_connection((host, port))
+    try:
+        sock.settimeout(5.0)
+    except BaseException:
+        sock.close()
+        raise
+    return sock

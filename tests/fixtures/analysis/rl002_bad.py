@@ -1,0 +1,10 @@
+"""RL002: the early return leaks the freshly-dialed socket."""
+import socket
+
+
+def probe(host, port, want):
+    sock = socket.create_connection((host, port))
+    if not want:
+        return None
+    sock.close()
+    return True

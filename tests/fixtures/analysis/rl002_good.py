@@ -1,0 +1,11 @@
+"""RL002 clean: every non-exceptional path closes the socket."""
+import socket
+
+
+def probe(host, port, want):
+    sock = socket.create_connection((host, port))
+    if not want:
+        sock.close()
+        return None
+    sock.close()
+    return True

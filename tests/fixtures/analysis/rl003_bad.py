@@ -1,0 +1,9 @@
+"""RL003: recv on a socket that is closed on every path reaching
+the call."""
+import socket
+
+
+def reuse(host, port):
+    sock = socket.create_connection((host, port))
+    sock.close()
+    return sock.recv(16)

@@ -1,0 +1,11 @@
+"""RL003 clean: the use happens strictly before the close (and the
+close is guaranteed by the finally)."""
+import socket
+
+
+def reuse(host, port):
+    sock = socket.create_connection((host, port))
+    try:
+        return sock.recv(16)
+    finally:
+        sock.close()

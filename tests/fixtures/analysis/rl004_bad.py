@@ -1,0 +1,8 @@
+"""RL004: second close on a socket already closed on every path."""
+import socket
+
+
+def shutdown(host, port):
+    sock = socket.create_connection((host, port))
+    sock.close()
+    sock.close()

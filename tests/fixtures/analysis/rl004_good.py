@@ -1,0 +1,10 @@
+"""RL004 clean: None-out after close makes a repeat call
+impossible (and the rebinding retires the tracked fact)."""
+import socket
+
+
+def shutdown(host, port):
+    sock = socket.create_connection((host, port))
+    sock.close()
+    sock = None
+    return sock

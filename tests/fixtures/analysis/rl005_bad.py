@@ -1,0 +1,10 @@
+"""RL005: the early return skips the wait — the child is left a
+zombie."""
+import subprocess
+
+
+def spawn(cmd):
+    proc = subprocess.Popen(cmd)
+    if not cmd:
+        return None
+    proc.wait()

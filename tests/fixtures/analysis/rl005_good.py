@@ -1,0 +1,8 @@
+"""RL005 clean: `with Popen(...)` settles the child on every path
+(the context manager waits on exit)."""
+import subprocess
+
+
+def spawn(cmd):
+    with subprocess.Popen(cmd) as proc:
+        proc.communicate()

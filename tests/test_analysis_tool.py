@@ -43,6 +43,14 @@ CASES = {
     "SF003": ("sf003_bad.py", "sf003_good.py", "secretflow"),
     "SF004": ("sf004_bad.py", "sf004_good.py", "secretflow"),
     "SF005": ("sf005_bad.py", "sf005_good.py", "secretflow"),
+    "RL001": ("rl001_bad.py", "rl001_good.py", "lifetime"),
+    "RL002": ("rl002_bad.py", "rl002_good.py", "lifetime"),
+    "RL003": ("rl003_bad.py", "rl003_good.py", "lifetime"),
+    "RL004": ("rl004_bad.py", "rl004_good.py", "lifetime"),
+    "RL005": ("rl005_bad.py", "rl005_good.py", "lifetime"),
+    "EV001": ("ev001_bad.py", "ev001_good.py", "evloop"),
+    "EV002": ("ev002_bad.py", "ev002_good.py", "evloop"),
+    "EV003": ("ev003_bad.py", "ev003_good.py", "evloop"),
 }
 
 
@@ -419,3 +427,140 @@ def test_net_package_is_in_analyzer_scope():
     assert robustness.in_scope("tools/loadgen.py")
     assert secretflow.wp_in_scope("tools/loadgen.py")
     assert not robustness.in_scope("mastic_tpu/ops/field_jax.py")
+
+
+# -- ISSUE 17: the CFG engine, incremental cache, determinism --------
+
+def test_lifetime_and_evloop_files_in_analyzer_scope():
+    """The session/network plane the event-loop rewrite lands on is
+    inside both new passes' scopes."""
+    from tools.analysis import evloop, lifetime
+
+    for rel in ("mastic_tpu/net/transport.py",
+                "mastic_tpu/net/ingest.py",
+                "mastic_tpu/drivers/session.py",
+                "mastic_tpu/drivers/parties.py",
+                "tools/party.py", "tools/serve.py",
+                "tools/loadgen.py"):
+        assert lifetime.in_scope(rel), rel
+        assert evloop.in_scope(rel), rel
+    assert not lifetime.in_scope("mastic_tpu/ops/field_jax.py")
+    assert not evloop.in_scope("mastic_tpu/ops/field_jax.py")
+
+
+def test_stale_allow_on_cfg_rules_is_flagged():
+    """AL002 fires for RL/EV allows too: a leak that got fixed must
+    not leave its allow behind (satellite 5)."""
+    (findings, suppressed) = run_fixture("al002_rl_bad.py",
+                                         "lifetime")
+    assert [f.rule for f in findings] == ["AL002"]
+    assert suppressed == []
+
+
+def test_budget_bump_workflow():
+    """The budget gate's two directions: growth past the committed
+    baseline trips it, and an explicit baseline bump in the same diff
+    (the documented workflow) clears it again."""
+    (_findings, suppressed) = _tree_run()
+    stats = analysis.suppression_stats(suppressed)
+    budget = analysis.load_budget()
+    over = dict(stats)
+    over["total"] = budget["total"] + 1
+    assert analysis.check_budget(over, budget), (
+        "one allow past the baseline must trip the gate")
+    bumped = dict(budget)
+    bumped["total"] = budget["total"] + 1
+    assert analysis.check_budget(over, bumped) == [], (
+        "a baseline bump in the diff must clear the gate")
+
+
+def _cache_cli(tmp_path, *extra):
+    import json
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["MASTIC_ANALYSIS_CACHE_DIR"] = str(tmp_path / "cache")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.analysis", "--json", "--stats",
+         "--force-scope", "--pass", "lifetime", "--pass", "evloop",
+         str(FIXTURES / "rl002_bad.py"),
+         str(FIXTURES / "ev001_bad.py"), *extra],
+        capture_output=True, text=True, env=env,
+        cwd=str(pathlib.Path(__file__).parent.parent))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    return json.loads(proc.stdout)
+
+
+def test_cache_warm_run_hits_and_is_identical(tmp_path):
+    """Satellite 1 acceptance: the second run over unchanged content
+    serves every file AND the whole-program layer from the cache
+    (hit counts asserted), and its findings are byte-identical to
+    the cold run's."""
+    import json
+
+    cold = _cache_cli(tmp_path)
+    warm = _cache_cli(tmp_path)
+    assert cold["cache"] == {"hits": 0, "misses": 2,
+                             "program_hit": False}
+    assert warm["cache"] == {"hits": 2, "misses": 0,
+                             "program_hit": True}
+    for key in ("findings", "suppressed", "stats"):
+        assert json.dumps(cold[key]) == json.dumps(warm[key]), key
+    assert cold["findings"], "the fixtures must produce findings"
+
+
+def test_no_cache_flag_runs_cold(tmp_path):
+    warm_dir = _cache_cli(tmp_path)
+    assert warm_dir["cache"]["misses"] == 2
+    cold = _cache_cli(tmp_path, "--no-cache")
+    assert cold["cache"] is None
+
+
+def test_cache_invalidates_on_set_change(tmp_path):
+    """Changing the analyzed content re-runs exactly the changed part:
+    untouched files stay warm, but the whole-program entry (keyed over
+    every file's digest) goes cold."""
+    base = [FIXTURES / "rl002_bad.py", FIXTURES / "ev001_bad.py"]
+    cache = analysis.AnalysisCache(root=tmp_path / "cache")
+    analysis.analyze_paths(base, only_passes={"lifetime", "evloop"},
+                           force_scope=True, cache=cache)
+    assert (cache.hits, cache.misses) == (0, 2)
+    cache2 = analysis.AnalysisCache(root=tmp_path / "cache")
+    analysis.analyze_paths(base + [FIXTURES / "rl001_bad.py"],
+                           only_passes={"lifetime", "evloop"},
+                           force_scope=True, cache=cache2)
+    assert (cache2.hits, cache2.misses) == (2, 1)
+    assert not cache2.program_hit, (
+        "a changed file set must invalidate the whole-program entry")
+
+
+def test_findings_and_sarif_are_deterministically_ordered():
+    """Satellite 2: findings sort by (path, line, rule) no matter the
+    input path order, and the SARIF results stream interleaves
+    suppressed and unsuppressed entries in that same one order with
+    repo-relative URIs only."""
+    paths = [FIXTURES / "ev001_bad.py", FIXTURES / "rl002_bad.py",
+             FIXTURES / "rl001_bad.py"]
+    (fwd, _s1) = analysis.analyze_paths(
+        paths, only_passes={"lifetime", "evloop"}, force_scope=True)
+    (rev, _s2) = analysis.analyze_paths(
+        list(reversed(paths)), only_passes={"lifetime", "evloop"},
+        force_scope=True)
+    keys = [f.key() for f in fwd]
+    assert keys == sorted(keys)
+    assert keys == [f.key() for f in rev]
+
+    log = _sarif_for(analysis.default_files())
+    results = log["runs"][0]["results"]
+    sarif_keys = [(r["locations"][0]["physicalLocation"]
+                    ["artifactLocation"]["uri"],
+                   r["locations"][0]["physicalLocation"]
+                    ["region"]["startLine"],
+                   r["ruleId"]) for r in results]
+    assert sarif_keys == sorted(sarif_keys)
+    import json as _json
+    dump = _json.dumps(log)
+    assert str(analysis.REPO) not in dump, (
+        "SARIF must carry repo-relative URIs only")

@@ -151,6 +151,42 @@ def test_rule_table_docs_flags_stale_row(monkeypatch):
     assert any("CC001" in p and "stale" in p for p in problems)
 
 
+# -- check 11: refusal/shed reason codes <-> USAGE.md -----------------
+
+def test_reason_docs_clean_on_repo():
+    assert lint.check_reason_docs() == []
+
+
+def test_reason_vocabulary_is_collected():
+    """The AST collection sees both flavors of reason source: string
+    literals at the shed sinks and the TLS_* constants."""
+    reasons = lint._counted_reasons()
+    assert "tenant-quarantined" in reasons
+    assert "rate-limited" in reasons          # Name arg via REASON_*
+    assert "tls-handshake-failed" in reasons  # TLS_* constant
+    assert "shed" not in reasons              # no hyphen, not a code
+
+
+def test_reason_docs_flags_undocumented_reason(monkeypatch):
+    real = lint._counted_reasons()
+    padded = dict(real)
+    padded["never-documented"] = "mastic_tpu/fake.py"
+    monkeypatch.setattr(lint, "_counted_reasons", lambda: padded)
+    problems = lint.check_reason_docs()
+    assert any("never-documented" in p and "no row" in p
+               for p in problems)
+
+
+def test_reason_docs_flags_stale_row(monkeypatch):
+    real = lint._counted_reasons()
+    trimmed = {k: v for (k, v) in real.items()
+               if k != "rate-limited"}
+    monkeypatch.setattr(lint, "_counted_reasons", lambda: trimmed)
+    problems = lint.check_reason_docs()
+    assert any("rate-limited" in p and "stale" in p
+               for p in problems)
+
+
 # -- the gate itself --------------------------------------------------
 
 def test_repo_lint_is_clean():

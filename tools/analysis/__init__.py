@@ -4,7 +4,7 @@ make this reproduction's bit-exact crypto survive jit + Pallas and
 its collector service survive a second thread (run via
 `make analyze`; part of `make ci`).
 
-Seven passes, each with stable rule IDs, each scoped to the layer
+Nine passes, each with stable rule IDs, each scoped to the layer
 whose contract it checks:
 
   tracesafe   TS001-TS004   mastic_tpu/ops/, backend/, flp/flp_jax.py
@@ -19,6 +19,18 @@ whose contract it checks:
   observability OB001       mastic_tpu/ library code
   concurrency CC001-CC004   whole-program: drivers/, obs/,
                             tools/serve.py (threads + locks)
+  lifetime    RL001-RL005   CFG path-sensitive resource lifetimes:
+                            mastic_tpu/net/ + session/party drivers
+                            + tools/{party,serve,loadgen}.py
+  evloop      EV001-EV003   whole-program: blocking calls / send
+                            loops in non-blocking (selector)
+                            contexts, same scope as lifetime
+
+The lifetime pass runs on the CFG engine (`cfg.py`): every function
+is lowered to basic blocks with explicit raise edges out of every
+call, and per-resource open/closed facts are pushed along all paths
+to fixpoint (ISSUE 17 — the static gate the event-loop ingest
+rewrite lands on).
 
 plus the suppression meta-rules AL001 (mastic-allow without a written
 justification) and AL002 (mastic-allow that silences nothing), and
@@ -46,20 +58,97 @@ SARIF 2.1.0 log for CI artifact upload.
 See USAGE.md ("Static analysis") for the rule table and workflow.
 """
 
+import hashlib
 import json
+import os
 import pathlib
+import time
 
-from . import (callgraph, concurrency, dtypes, observability,
-               pallasck, robustness, secretflow, tracesafe)
+from . import (callgraph, concurrency, dtypes, evloop, lifetime,
+               observability, pallasck, robustness, secretflow,
+               tracesafe)
 from .core import REPO, Finding, load_file
 from .sarif import to_sarif
 
 PASSES = (tracesafe, dtypes, secretflow, pallasck, robustness,
-          observability, concurrency)
+          observability, concurrency, lifetime, evloop)
 
 DEFAULT_ROOTS = ("mastic_tpu", "tools", "bench.py")
 
 BUDGET_FILE = pathlib.Path(__file__).parent / "allow_budget.json"
+
+CACHE_DIR = REPO / "artifacts" / "analysis-cache"
+
+
+def _analyzer_fingerprint() -> bytes:
+    """SHA-256 over the analyzer's own sources: any change to a pass,
+    the CFG engine or the call-graph model invalidates every cached
+    entry (no manual version bumps to forget)."""
+    h = hashlib.sha256()
+    for path in sorted(pathlib.Path(__file__).parent.glob("*.py")):
+        h.update(path.name.encode())
+        h.update(path.read_bytes())
+    return h.digest()
+
+
+class AnalysisCache:
+    """Content-addressed result cache (ISSUE 17 satellite).  Per-file
+    pass results are keyed by content SHA-256 + analyzer fingerprint
+    + run flags; the whole-program layer (call graph, concurrency,
+    SF300s, lifetime, evloop) is a property of the file SET, so it is
+    cached as one entry keyed over every file's digest — touch any
+    file and only the interprocedural work plus that file rerun.  A
+    fully warm run is parse + suppression matching only.  Entries are
+    plain JSON under artifacts/analysis-cache/ (override:
+    MASTIC_ANALYSIS_CACHE_DIR)."""
+
+    def __init__(self, root=None):
+        self.root = pathlib.Path(
+            root or os.environ.get("MASTIC_ANALYSIS_CACHE_DIR",
+                                   CACHE_DIR))
+        self.hits = 0
+        self.misses = 0
+        self.program_hit = False
+        self._fp = _analyzer_fingerprint()
+
+    def key(self, info, pass_names, force_scope: bool) -> str:
+        h = hashlib.sha256()
+        h.update(self._fp)
+        h.update(hashlib.sha256(info.src.encode()).digest())
+        h.update(info.rel.encode())
+        h.update(repr((sorted(pass_names), force_scope)).encode())
+        return h.hexdigest()
+
+    def program_key(self, infos, pass_names,
+                    force_scope: bool) -> str:
+        """One key over the whole file SET: the interprocedural
+        results depend on every file, so any content change anywhere
+        invalidates them (and an unchanged tree skips the call-graph
+        build entirely)."""
+        h = hashlib.sha256()
+        h.update(b"whole-program")
+        h.update(self._fp)
+        for info in sorted(infos, key=lambda i: i.rel):
+            h.update(info.rel.encode())
+            h.update(hashlib.sha256(info.src.encode()).digest())
+        h.update(repr((sorted(pass_names), force_scope)).encode())
+        return h.hexdigest()
+
+    def get(self, key: str):
+        try:
+            return json.loads(
+                (self.root / f"{key}.json").read_text())
+        except (OSError, ValueError):
+            return None
+
+    def put(self, key: str, rows: list) -> None:
+        try:
+            self.root.mkdir(parents=True, exist_ok=True)
+            tmp = self.root / f".{key}.tmp"
+            tmp.write_text(json.dumps(rows))
+            tmp.replace(self.root / f"{key}.json")
+        except OSError:
+            pass    # a read-only checkout just runs cold
 
 _RULE_TABLE = {}
 for _p in PASSES:
@@ -97,15 +186,18 @@ def load_paths(paths):
     return (infos, parse_findings)
 
 
-def analyze_paths(paths, only_passes=None, force_scope=False):
+def analyze_paths(paths, only_passes=None, force_scope=False,
+                  cache=None):
     """Run the passes over `paths`.
 
     only_passes: iterable of pass names (e.g. {"tracesafe"}) to run a
     subset; force_scope: apply the passes regardless of each pass's
     path scope (how the fixture self-tests drive files that live under
-    tests/fixtures/).  Returns (findings, suppressed) where both are
-    lists of Finding — `findings` is what gates CI, `suppressed` is
-    what inline allows silenced.
+    tests/fixtures/); cache: an AnalysisCache to skip the per-file
+    passes on content-identical files (None runs everything cold).
+    Returns (findings, suppressed) where both are lists of Finding —
+    `findings` is what gates CI, `suppressed` is what inline allows
+    silenced.
 
     Each file is parsed once; the per-file passes and the
     whole-program layer (call graph + concurrency + interprocedural
@@ -113,26 +205,55 @@ def analyze_paths(paths, only_passes=None, force_scope=False):
     """
     selected = [p for p in PASSES
                 if only_passes is None or p.PASS_NAME in only_passes]
+    pass_names = [p.PASS_NAME for p in selected]
     (infos, findings) = load_paths(paths)
     findings = list(findings)
     suppressed: list = []
 
     raw_by_rel = {info.rel: [] for info in infos}
     for info in infos:
+        key = (cache.key(info, pass_names, force_scope)
+               if cache is not None else None)
+        rows = cache.get(key) if cache is not None else None
+        if rows is not None:
+            cache.hits += 1
+            raw_by_rel[info.rel] = [
+                Finding(rule, info.rel, line, msg)
+                for (rule, line, msg) in rows]
+            continue
         for mod in selected:
             if force_scope or _pass_applies(mod, info.rel, info.tree):
                 raw_by_rel[info.rel] += mod.check(info)
-    # The whole-program layer: one Program over the run's files.
-    if any(getattr(mod, "WHOLE_PROGRAM", False) for mod in selected) \
-            and infos:
-        program = callgraph.Program(infos)
-        for mod in selected:
-            if not getattr(mod, "WHOLE_PROGRAM", False):
-                continue
-            for f in mod.check_program(program,
-                                       force_scope=force_scope):
-                if f.rel in raw_by_rel:
-                    raw_by_rel[f.rel].append(f)
+        if cache is not None:
+            cache.misses += 1
+            cache.put(key, [[f.rule, f.line, f.msg]
+                            for f in raw_by_rel[info.rel]])
+    # The whole-program layer: one Program over the run's files —
+    # cached as a unit (any changed file invalidates it), so a fully
+    # warm run skips the call-graph build and every fixpoint.
+    wp = [mod for mod in selected
+          if getattr(mod, "WHOLE_PROGRAM", False)]
+    if wp and infos:
+        pkey = (cache.program_key(infos, pass_names, force_scope)
+                if cache is not None else None)
+        rows = cache.get(pkey) if cache is not None else None
+        if rows is not None:
+            cache.program_hit = True
+            for (rule, rel, line, msg) in rows:
+                if rel in raw_by_rel:
+                    raw_by_rel[rel].append(Finding(rule, rel, line,
+                                                   msg))
+        else:
+            program = callgraph.Program(infos)
+            rows = []
+            for mod in wp:
+                for f in mod.check_program(program,
+                                           force_scope=force_scope):
+                    if f.rel in raw_by_rel:
+                        raw_by_rel[f.rel].append(f)
+                        rows.append([f.rule, f.rel, f.line, f.msg])
+            if cache is not None:
+                cache.put(pkey, rows)
 
     for info in infos:
         for f in raw_by_rel[info.rel]:
@@ -240,13 +361,19 @@ def main(argv=None) -> int:
                         help="print per-rule mastic-allow counts and "
                              "fail when the total exceeds the "
                              "committed allow_budget.json baseline")
+    parser.add_argument("--no-cache", action="store_true",
+                        help="ignore artifacts/analysis-cache/ and "
+                             "run every per-file pass cold")
     args = parser.parse_args(argv)
 
     files = ([pathlib.Path(p).resolve() for p in args.paths]
              if args.paths else default_files())
+    cache = None if args.no_cache else AnalysisCache()
+    t0 = time.monotonic()
     (findings, suppressed_list) = analyze_paths(
         files, only_passes=set(args.only) if args.only else None,
-        force_scope=args.force_scope)
+        force_scope=args.force_scope, cache=cache)
+    elapsed = time.monotonic() - t0
 
     stats = suppression_stats(suppressed_list)
     budget_problems: list = []
@@ -271,12 +398,23 @@ def main(argv=None) -> int:
         if args.stats:
             payload["stats"] = stats
             payload["budget_problems"] = budget_problems
+            payload["cache"] = (
+                {"hits": cache.hits, "misses": cache.misses,
+                 "program_hit": cache.program_hit}
+                if cache is not None else None)
+            payload["wall_s"] = round(elapsed, 3)
         print(json.dumps(payload, indent=2))
     else:
         for f in findings:
             print(f.text())
         if args.stats:
             print(_render_stats(stats, load_budget()))
+            if cache is not None:
+                wp_state = ("warm" if cache.program_hit else "cold")
+                print(f"  cache: {cache.hits} warm / "
+                      f"{cache.hits + cache.misses} files, "
+                      f"program layer {wp_state}")
+            print(f"  wall: {elapsed:.2f}s")
             for problem in budget_problems:
                 print(f"analyze: {problem}")
         print(f"analyze: {len(files)} files, {len(findings)} "

@@ -162,6 +162,7 @@ class Program:
         self.lock_ids: set = set()
         self.entry_locks: dict = {}      # qual -> frozenset(lock ids)
         self._regions_cache: dict = {}
+        self._ctor_cache: dict = {}      # qual -> {local: ctor name}
         self._collect()
         self._resolve_imports()
         self._resolve_edges()
@@ -412,23 +413,36 @@ class Program:
             return tuple(cands)
         return ()
 
+    def _local_ctors(self, fn: FuncNode) -> dict:
+        """local name -> constructor name for this scope's single-Name
+        assignments, built once per function (receiver_class is hot —
+        the concurrency and evloop passes query it per access, and a
+        rescan per query made the whole-program layer quadratic)."""
+        cached = self._ctor_cache.get(fn.qual)
+        if cached is None:
+            cached = {}
+            for node in _Scope.iter(fn.node):
+                if isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    ctor = self._ctor_name(node.value)
+                    if ctor:
+                        cached.setdefault(node.targets[0].id, ctor)
+            self._ctor_cache[fn.qual] = cached
+        return cached
+
     def receiver_class(self, fn: FuncNode, expr):
         """Best-effort class of a receiver expression: a local bound
         to a known constructor, or a `self.attr` the class's __init__
         typed.  Returns ClassNode, an external-ctor name string, or
         None."""
         if isinstance(expr, ast.Name):
-            for node in _Scope.iter(fn.node):
-                if isinstance(node, ast.Assign) \
-                        and len(node.targets) == 1 \
-                        and isinstance(node.targets[0], ast.Name) \
-                        and node.targets[0].id == expr.id:
-                    ctor = self._ctor_name(node.value)
-                    if ctor:
-                        known = self.classes_by_name.get(ctor)
-                        if known and len(known) == 1:
-                            return known[0]
-                        return ctor
+            ctor = self._local_ctors(fn).get(expr.id)
+            if ctor:
+                known = self.classes_by_name.get(ctor)
+                if known and len(known) == 1:
+                    return known[0]
+                return ctor
             return None
         if isinstance(expr, ast.Attribute) \
                 and isinstance(expr.value, ast.Name) \

@@ -1,0 +1,434 @@
+"""Intraprocedural control-flow graphs for the path-sensitive passes
+(ISSUE 17).
+
+The AST passes see statements; the resource-lifetime (RL) and
+event-loop-readiness (EV) rules need *paths*: "is there an execution
+on which this socket reaches function exit unclosed?" is a question
+about edges, not nodes.  `build(func)` lowers one function body (from
+the same single-parse FileInfos every other pass shares) to basic
+blocks with explicit edges for if/while/for/try/except/finally/with/
+return/raise/break/continue, and `solve()` is the gen/kill dataflow
+driver the passes run to fixpoint over it.
+
+Design decisions that matter to the consumers:
+
+* **one element per block** — every block carries at most one
+  "element": a simple ast.stmt, a branch/loop test (bare ast.expr),
+  or a tagged tuple ``("for", node)`` / ``("with", item, node)``.
+  Transfer functions therefore never reason about intra-block order.
+
+* **raise edges out of every call** — any element containing a Call
+  (plus assert/raise) gets an EXC edge to the innermost active
+  handler (or the virtual `raise_exit`).  The driver feeds EXC edges
+  from the *exc_out* facts the transfer computes for the element —
+  the convention the RL pass uses is "kills commit, gens do not": a
+  failing acquisition acquired nothing, a failing cleanup still
+  counts as cleanup.
+
+* **finally duplication** — each abnormal exit (return/break/continue
+  crossing a try/finally) inlines its own copy of the finalbody, so a
+  close() in a finally kills the fact on the return path without
+  conflating it with the fall-through path.  The exception channel of
+  one try shares a single finalbody copy (per-raise duplication would
+  explode); handler bodies raise into that same copy.
+
+* **None-guard pruning** — a branch test of the shape ``x``,
+  ``not x``, ``x is None`` / ``x is not None`` kills the facts for
+  ``x`` on the edge where it is known None/falsy, so the ubiquitous
+  ``finally: if sock is not None: sock.close()`` pattern does not
+  report the None path as a leak.
+
+Known blind spots (documented in USAGE.md): exception *types* are not
+matched — a raise may reach any handler of the enclosing try (plus
+the outer context when no handler is catch-all); `with` __exit__
+suppression is not modeled; comprehensions are treated as opaque
+expressions; `while True` without break simply never reaches the
+normal exit (sound for leak detection — no path, no report).
+"""
+
+import ast
+from collections import deque
+
+FLOW = "flow"
+TRUE = "true"
+FALSE = "false"
+EXC = "exc"
+
+_CATCH_ALL = {"Exception", "BaseException"}
+
+
+class Block:
+    __slots__ = ("idx", "elem", "succ")
+
+    def __init__(self, idx: int):
+        self.idx = idx
+        self.elem = None     # ast.stmt | ast.expr | tagged tuple | None
+        self.succ = []       # [(Block, kind)]
+
+    def __repr__(self):   # debugging aid only
+        kind = type(self.elem).__name__ if self.elem is not None else "-"
+        return f"<B{self.idx} {kind} ->{[s.idx for (s, _k) in self.succ]}>"
+
+
+class CFG:
+    __slots__ = ("func", "blocks", "entry", "exit", "raise_exit")
+
+    def __init__(self, func, blocks, entry, exit_b, raise_exit):
+        self.func = func
+        self.blocks = blocks
+        self.entry = entry
+        self.exit = exit_b            # normal exit (return / fall-off)
+        self.raise_exit = raise_exit  # uncaught-exception exit
+
+
+class _Ctx:
+    """Where control transfers OUT of the current statement list go:
+    `handler` is the innermost active exception target, `finallies`
+    the stack of pending (finalbody, ctx-to-run-it-under) pairs an
+    abnormal exit must inline, `loops` the (head, after, fin-depth)
+    stack for continue/break."""
+
+    __slots__ = ("handler", "finallies", "loops")
+
+    def __init__(self, handler, finallies=(), loops=()):
+        self.handler = handler
+        self.finallies = finallies
+        self.loops = loops
+
+    def push_finally(self, finalbody, outer):
+        return _Ctx(self.handler, self.finallies + ((finalbody, outer),),
+                    self.loops)
+
+    def with_handler(self, handler):
+        return _Ctx(handler, self.finallies, self.loops)
+
+    def push_loop(self, head, after):
+        return _Ctx(self.handler, self.finallies,
+                    self.loops + ((head, after, len(self.finallies)),))
+
+
+def _contains_call(node) -> bool:
+    return any(isinstance(n, ast.Call) for n in ast.walk(node))
+
+
+def _stmt_can_raise(st) -> bool:
+    if isinstance(st, (ast.Raise, ast.Assert)):
+        return True
+    return _contains_call(st)
+
+
+def _const_truth(expr):
+    """True/False for a constant test, None when the test is dynamic."""
+    if isinstance(expr, ast.Constant):
+        return bool(expr.value)
+    return None
+
+
+class _Builder:
+    def __init__(self, func):
+        self.func = func
+        self.blocks = []
+        self.exit = self._new()
+        self.raise_exit = self._new()
+
+    def _new(self) -> Block:
+        b = Block(len(self.blocks))
+        self.blocks.append(b)
+        return b
+
+    def _edge(self, a, b, kind=FLOW) -> None:
+        if a is not None and b is not None:
+            a.succ.append((b, kind))
+
+    def build(self) -> CFG:
+        entry = self._new()
+        ctx = _Ctx(handler=self.raise_exit)
+        end = self.seq(self.func.body, entry, ctx)
+        self._edge(end, self.exit)    # fall off the end: implicit return
+        return CFG(self.func, self.blocks, entry, self.exit,
+                   self.raise_exit)
+
+    # -- statement lowering ------------------------------------------
+
+    def seq(self, stmts, cur, ctx):
+        for st in stmts:
+            if cur is None:
+                break               # unreachable tail
+            cur = self.stmt(st, cur, ctx)
+        return cur
+
+    def _elem(self, cur, elem, raises, ctx, exc_to=None):
+        """Append one element block after `cur`; returns the new empty
+        continuation block."""
+        b = self._new()
+        self._edge(cur, b)
+        b.elem = elem
+        if raises:
+            self._edge(b, exc_to if exc_to is not None else ctx.handler,
+                       EXC)
+        nxt = self._new()
+        self._edge(b, nxt)
+        return (b, nxt)
+
+    def _unwind(self, cur, ctx, target, depth=0):
+        """Inline the pending finallies (innermost first) down to stack
+        depth `depth`, then edge to `target`."""
+        for (finalbody, fctx) in reversed(ctx.finallies[depth:]):
+            entry = self._new()
+            self._edge(cur, entry)
+            cur = self.seq(finalbody, entry, fctx)
+            if cur is None:
+                return              # the finally itself never completes
+        self._edge(cur, target)
+
+    def stmt(self, st, cur, ctx):
+        if isinstance(st, ast.If):
+            return self._if(st, cur, ctx)
+        if isinstance(st, ast.While):
+            return self._while(st, cur, ctx)
+        if isinstance(st, (ast.For, ast.AsyncFor)):
+            return self._for(st, cur, ctx)
+        if isinstance(st, ast.Try):
+            return self._try(st, cur, ctx)
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            return self._with(st, cur, ctx)
+        if isinstance(st, ast.Return):
+            (b, _nxt) = self._elem(cur, st, _stmt_can_raise(st), ctx)
+            self._unwind(b, ctx, self.exit)
+            return None
+        if isinstance(st, ast.Raise):
+            b = self._new()
+            self._edge(cur, b)
+            b.elem = st
+            self._edge(b, ctx.handler, EXC)
+            return None
+        if isinstance(st, ast.Break):
+            if not ctx.loops:
+                return cur          # malformed; tolerate
+            (_head, after, depth) = ctx.loops[-1]
+            b = self._new()
+            self._edge(cur, b)
+            self._unwind(b, ctx, after, depth)
+            return None
+        if isinstance(st, ast.Continue):
+            if not ctx.loops:
+                return cur
+            (head, _after, depth) = ctx.loops[-1]
+            b = self._new()
+            self._edge(cur, b)
+            self._unwind(b, ctx, head, depth)
+            return None
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            return cur              # nested scopes are their own CFGs
+        # Simple statement.
+        (_b, nxt) = self._elem(cur, st, _stmt_can_raise(st), ctx)
+        return nxt
+
+    def _if(self, st, cur, ctx):
+        (tb, _nxt) = self._elem(cur, st.test, _contains_call(st.test),
+                                ctx)
+        tb.succ = [s for s in tb.succ if s[1] != FLOW]
+        join = self._new()
+        truth = _const_truth(st.test)
+        if truth is not False:
+            then_entry = self._new()
+            self._edge(tb, then_entry, TRUE)
+            then_end = self.seq(st.body, then_entry, ctx)
+            self._edge(then_end, join)
+        if truth is not True:
+            if st.orelse:
+                else_entry = self._new()
+                self._edge(tb, else_entry, FALSE)
+                else_end = self.seq(st.orelse, else_entry, ctx)
+                self._edge(else_end, join)
+            else:
+                self._edge(tb, join, FALSE)
+        return join
+
+    def _while(self, st, cur, ctx):
+        head_join = self._new()          # back-edge target
+        self._edge(cur, head_join)
+        (tb, _nxt) = self._elem(head_join, st.test,
+                                _contains_call(st.test), ctx)
+        tb.succ = [s for s in tb.succ if s[1] != FLOW]
+        after = self._new()
+        truth = _const_truth(st.test)
+        loop_ctx = ctx.push_loop(head_join, after)
+        if truth is not False:
+            body_entry = self._new()
+            self._edge(tb, body_entry, TRUE)
+            body_end = self.seq(st.body, body_entry, loop_ctx)
+            self._edge(body_end, head_join)
+        if truth is not True:
+            if st.orelse:
+                else_entry = self._new()
+                self._edge(tb, else_entry, FALSE)
+                else_end = self.seq(st.orelse, else_entry, ctx)
+                self._edge(else_end, after)
+            else:
+                self._edge(tb, after, FALSE)
+        return after
+
+    def _for(self, st, cur, ctx):
+        head_join = self._new()
+        self._edge(cur, head_join)
+        (hb, _nxt) = self._elem(head_join, ("for", st),
+                                _contains_call(st.iter), ctx)
+        hb.succ = [s for s in hb.succ if s[1] != FLOW]
+        after = self._new()
+        loop_ctx = ctx.push_loop(head_join, after)
+        body_entry = self._new()
+        self._edge(hb, body_entry, TRUE)      # iterator yielded
+        body_end = self.seq(st.body, body_entry, loop_ctx)
+        self._edge(body_end, head_join)
+        if st.orelse:
+            else_entry = self._new()
+            self._edge(hb, else_entry, FALSE)
+            else_end = self.seq(st.orelse, else_entry, ctx)
+            self._edge(else_end, after)
+        else:
+            self._edge(hb, after, FALSE)      # iterator exhausted
+        return after
+
+    def _with(self, st, cur, ctx):
+        for item in st.items:
+            (_b, cur) = self._elem(
+                cur, ("with", item, st),
+                _contains_call(item.context_expr), ctx)
+        body_end = self.seq(st.body, cur, ctx)
+        after = self._new()
+        self._edge(body_end, after)
+        return after
+
+    def _try(self, st, cur, ctx):
+        outer = ctx
+        # The exception channel's single finalbody copy: everything
+        # raised inside this try (uncaught by its handlers) runs it,
+        # then proceeds to the outer handler.
+        if st.finalbody:
+            fin_exc_entry = self._new()
+            fin_exc_end = self.seq(st.finalbody, fin_exc_entry, outer)
+            self._edge(fin_exc_end, outer.handler)
+            exc_escape = fin_exc_entry
+        else:
+            exc_escape = outer.handler
+
+        if st.handlers:
+            dispatch = self._new()
+            body_exc_target = dispatch
+        else:
+            body_exc_target = exc_escape
+
+        body_ctx = outer.with_handler(body_exc_target)
+        if st.finalbody:
+            body_ctx = body_ctx.push_finally(st.finalbody, outer)
+        body_entry = self._new()
+        self._edge(cur, body_entry)
+        body_end = self.seq(st.body, body_entry, body_ctx)
+
+        handler_ctx = outer.with_handler(exc_escape)
+        if st.finalbody:
+            handler_ctx = handler_ctx.push_finally(st.finalbody, outer)
+
+        if st.orelse and body_end is not None:
+            body_end = self.seq(st.orelse, body_end, handler_ctx)
+
+        normal_ends = [body_end]
+        catch_all = False
+        if st.handlers:
+            for h in st.handlers:
+                if h.type is None:
+                    catch_all = True
+                else:
+                    names = [h.type] if not isinstance(h.type, ast.Tuple) \
+                        else list(h.type.elts)
+                    for t in names:
+                        tail = _dotted_tail(t)
+                        if tail in _CATCH_ALL:
+                            catch_all = True
+                h_entry = self._new()
+                self._edge(dispatch, h_entry)
+                h_end = self.seq(h.body, h_entry, handler_ctx)
+                normal_ends.append(h_end)
+            if not catch_all:
+                # A raise may match no handler and escape this try.
+                self._edge(dispatch, exc_escape)
+
+        after = self._new()
+        if st.finalbody:
+            # The normal-completion finalbody copy (separate from the
+            # exception channel's so the paths stay distinguishable).
+            fin_entry = self._new()
+            fin_end = self.seq(st.finalbody, fin_entry, outer)
+            self._edge(fin_end, after)
+            for end in normal_ends:
+                self._edge(end, fin_entry)
+        else:
+            for end in normal_ends:
+                self._edge(end, after)
+        return after
+
+
+def _dotted_tail(node) -> str:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def build(func) -> CFG:
+    """CFG for one ast.FunctionDef / AsyncFunctionDef."""
+    return _Builder(func).build()
+
+
+# -- the gen/kill driver ----------------------------------------------
+
+def solve(cfg: CFG, transfer, entry_facts=frozenset()):
+    """Forward may-analysis to fixpoint.  `transfer(block, facts)`
+    returns a dict of edge-kind -> fact set; missing kinds default to
+    the FLOW entry (which itself defaults to the input unchanged).
+    EXC entries model "the element raised mid-way".  Returns the list
+    of per-block input fact sets, indexed by block idx."""
+    n = len(cfg.blocks)
+    preds = [[] for _ in range(n)]
+    for b in cfg.blocks:
+        for (s, kind) in b.succ:
+            preds[s.idx].append((b, kind))
+    ins = [frozenset()] * n
+    outs = [None] * n                 # block idx -> kind -> facts
+    ins[cfg.entry.idx] = frozenset(entry_facts)
+
+    def out_for(b, kind):
+        table = outs[b.idx]
+        if table is None:
+            return frozenset()
+        return table.get(kind, table.get(FLOW, frozenset()))
+
+    work = deque(cfg.blocks)
+    queued = {b.idx for b in cfg.blocks}
+    rounds = 0
+    limit = 64 * n + 64               # termination backstop
+    while work and rounds < limit:
+        rounds += 1
+        b = work.popleft()
+        queued.discard(b.idx)
+        acc = set(ins[b.idx]) if b is cfg.entry else set()
+        for (p, kind) in preds[b.idx]:
+            acc |= out_for(p, kind)
+        acc = frozenset(acc)
+        if outs[b.idx] is not None and acc == ins[b.idx]:
+            continue
+        ins[b.idx] = acc
+        table = transfer(b, acc)
+        if FLOW not in table:
+            table = dict(table)
+            table[FLOW] = acc
+        if table != outs[b.idx]:
+            outs[b.idx] = table
+            for (s, _kind) in b.succ:
+                if s.idx not in queued:
+                    queued.add(s.idx)
+                    work.append(s)
+    return ins

@@ -1,0 +1,288 @@
+"""Pass 9 — event-loop readiness (EV001-EV003, ISSUE 17).
+
+Scope: the same session/network plane as the lifetime pass — the
+code ROADMAP item 1 rewrites onto a selector event loop.  A function
+runs in a *non-blocking context* when it must not stall the loop:
+
+  * it makes a socket non-blocking (`x.setblocking(False)`), or
+  * it is registered as a selector callback (the data/3rd argument
+    of a `.register(...)` call that resolves to a known function), or
+  * the call graph reaches it ONLY from such functions — a helper
+    called both from a non-blocking context and from ordinary
+    blocking code is left alone (its blocking caller proves the call
+    may legitimately wait).
+
+Seed discovery and reachability ride the whole-program model
+(`callgraph.Program`, strong edges only — a multi-candidate name
+dispatch must not drag half the program into the loop's context);
+the lock facts reuse the same model the concurrency pass consumes.
+
+  EV001  blocking call in a non-blocking context: recv / recv_into /
+         accept / do_handshake / sleep / thread join / bare
+         queue-style `.get()` with no timeout.  A `timeout=` keyword
+         exempts the call; readiness ops (recv/recv_into/accept) are
+         exempt inside a selector callback or a function that drives
+         `.select()` itself — there the loop has already proven the
+         fd ready.
+  EV002  send loop without writability registration: a `while` loop
+         in a non-blocking context that calls `.send`/`.sendall`
+         with no `.register`/`.modify`/`.select` inside the loop —
+         a slow reader turns the loop body into a spin or a stall.
+  EV003  blocking call while holding a lock in a non-blocking
+         context (reported INSTEAD of EV001 for that call): the
+         loop stalls AND every thread needing the lock queues
+         behind it.
+
+Known blind spots (documented in USAGE.md): callbacks passed through
+containers or partial(), `setblocking` reached via helpers, and
+fileobj readiness checked by hand with `select.select` on lists.
+"""
+
+import ast
+
+from .core import Finding, dotted
+from .callgraph import _Scope
+
+PASS_NAME = "evloop"
+WHOLE_PROGRAM = True
+
+RULES = {
+    "EV001": "blocking call in a non-blocking (event-loop) context",
+    "EV002": "send loop without writability registration",
+    "EV003": "blocking call under a held lock in a non-blocking "
+             "context",
+}
+
+SCOPE_PREFIXES = ("mastic_tpu/net/",)
+EXTRA_FILES = ("mastic_tpu/drivers/session.py",
+               "mastic_tpu/drivers/parties.py",
+               "tools/party.py", "tools/serve.py", "tools/loadgen.py")
+
+_BLOCKING_ATTRS = {"recv", "recv_into", "accept", "do_handshake",
+                   "sleep", "join", "get"}
+_BLOCKING_NAMES = {"sleep"}
+_READINESS_OPS = {"recv", "recv_into", "accept"}
+_LOOP_DRIVER_OPS = {"register", "modify", "select"}
+
+
+def in_scope(rel: str) -> bool:
+    return rel.startswith(SCOPE_PREFIXES) or rel in EXTRA_FILES
+
+
+def check(info) -> list:
+    """Per-file entry point kept for interface symmetry; the real
+    work happens in check_program (the driver calls it once with the
+    run's Program)."""
+    return []
+
+
+# -- non-blocking context discovery -----------------------------------
+
+def _sets_nonblocking(fn) -> bool:
+    for node in _Scope.iter(fn.node):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "setblocking" \
+                and len(node.args) == 1 \
+                and isinstance(node.args[0], ast.Constant) \
+                and not node.args[0].value:
+            return True
+    return False
+
+
+def _resolve_value(program, fn, expr):
+    """The FuncNode a callback-valued expression names, or None."""
+    if isinstance(expr, ast.Name):
+        nested = program.functions.get(
+            f"{fn.qual}.<locals>.{expr.id}")
+        if nested is not None:
+            return nested
+        hit = program.names.get((fn.module, expr.id))
+        if hit and hit[0] == "func":
+            return program.functions.get(hit[1])
+        return None
+    if isinstance(expr, ast.Attribute) \
+            and isinstance(expr.value, ast.Name) \
+            and expr.value.id == "self" and fn.cls is not None:
+        return program._method_in(fn.cls, expr.attr)
+    return None
+
+
+def _callback_seeds(program) -> set:
+    """Functions registered as selector callbacks: the data/3rd
+    argument of any `.register(...)` call that resolves."""
+    out = set()
+    for fn in program.functions.values():
+        for (call, _targets) in fn.callees:
+            f = call.func
+            if not (isinstance(f, ast.Attribute)
+                    and f.attr == "register"):
+                continue
+            cand = call.args[2] if len(call.args) >= 3 else None
+            for kw in call.keywords:
+                if kw.arg == "data":
+                    cand = kw.value
+            target = _resolve_value(program, fn, cand) \
+                if cand is not None else None
+            if target is not None:
+                out.add(target.qual)
+    return out
+
+
+def _blocking_reach(program, seeds: set) -> set:
+    """Functions reachable from a blocking-OK entry (module bodies,
+    API entry points, thread roots) WITHOUT passing through a seed —
+    these may legitimately wait, so the pass leaves them alone."""
+    stack = []
+    for fn in program.functions.values():
+        if (fn.is_module or not fn.callers) and fn.qual not in seeds:
+            stack.append(fn.qual)
+    for roots in program.thread_roots.values():
+        for t in roots:
+            if t.qual not in seeds:
+                stack.append(t.qual)
+    seen: set = set()
+    while stack:
+        q = stack.pop()
+        if q in seen or q in seeds:
+            continue
+        seen.add(q)
+        fn = program.functions.get(q)
+        if fn is None:
+            continue
+        for (call, targets) in fn.callees:
+            if id(call) in fn.weak_calls:
+                continue
+            for t in targets:
+                stack.append(t.qual)
+    return seen
+
+
+def nonblocking_contexts(program) -> set:
+    """Quals of every function the pass holds to the no-blocking
+    contract: the seeds, plus everything only they (strongly) reach."""
+    seeds = _callback_seeds(program)
+    for fn in program.functions.values():
+        if not fn.is_module and _sets_nonblocking(fn):
+            seeds.add(fn.qual)
+    if not seeds:
+        return set()
+    seed_fns = [program.functions[q] for q in seeds
+                if q in program.functions]
+    reach = program._reach(seed_fns, strong_only=True)
+    return (reach - _blocking_reach(program, seeds)) | seeds
+
+
+# -- the rules --------------------------------------------------------
+
+def _is_blocking(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "timeout":
+            return False
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id in _BLOCKING_NAMES
+    if not isinstance(f, ast.Attribute):
+        return False
+    attr = f.attr
+    if attr not in _BLOCKING_ATTRS:
+        return False
+    # "sep".join(...) is string formatting, not thread join.
+    if attr == "join" and isinstance(f.value, ast.Constant):
+        return False
+    # `d.get(key)` is a dict lookup; a bare `.get()` is queue-style
+    # and blocks until an item arrives.
+    if attr == "get" and call.args:
+        return False
+    return True
+
+
+def _drives_select(fn) -> bool:
+    for node in _Scope.iter(fn.node):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "select":
+            return True
+    return False
+
+
+def _lock_name(lid) -> str:
+    return f"{lid[1]}.{lid[2]}"
+
+
+def _check_blocking_calls(program, nb, callbacks, findings) -> None:
+    for qual in sorted(nb):
+        fn = program.functions.get(qual)
+        if fn is None or fn.is_module:
+            continue
+        readiness_ok = qual in callbacks or _drives_select(fn)
+        for (call, _targets) in fn.callees:
+            if not _is_blocking(call):
+                continue
+            attr = (call.func.attr
+                    if isinstance(call.func, ast.Attribute)
+                    else call.func.id)
+            if attr in _READINESS_OPS and readiness_ok:
+                continue
+            held = program.locks_held_at(fn, call)
+            name = dotted(call.func) or attr
+            if held:
+                findings.append(Finding(
+                    "EV003", fn.rel, call.lineno,
+                    f"blocking call '{name}' under "
+                    f"{_lock_name(sorted(held)[0])} in non-blocking "
+                    f"context {fn.name}() — the event loop stalls "
+                    f"and every lock waiter queues behind it; "
+                    f"release the lock or use a timeout"))
+            else:
+                findings.append(Finding(
+                    "EV001", fn.rel, call.lineno,
+                    f"blocking call '{name}' in non-blocking "
+                    f"context {fn.name}() — use a timeout, defer to "
+                    f"the selector, or restructure so readiness is "
+                    f"proven first"))
+
+
+def _check_send_loops(program, nb, findings) -> None:
+    for qual in sorted(nb):
+        fn = program.functions.get(qual)
+        if fn is None or fn.is_module:
+            continue
+        for node in _Scope.iter(fn.node):
+            if not isinstance(node, ast.While):
+                continue
+            sends = []
+            driven = False
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call) \
+                        and isinstance(sub.func, ast.Attribute):
+                    if sub.func.attr in ("send", "sendall"):
+                        sends.append(sub)
+                    elif sub.func.attr in _LOOP_DRIVER_OPS:
+                        driven = True
+            if sends and not driven:
+                findings.append(Finding(
+                    "EV002", fn.rel, sends[0].lineno,
+                    f"send loop in non-blocking context {fn.name}() "
+                    f"has no writability registration — register "
+                    f"EVENT_WRITE (or select) inside the loop so a "
+                    f"slow reader cannot wedge the event loop"))
+
+
+def check_program(program, force_scope: bool = False) -> list:
+    findings: list = []
+    callbacks = _callback_seeds(program)
+    nb = nonblocking_contexts(program)
+    if nb:
+        _check_blocking_calls(program, nb, callbacks, findings)
+        _check_send_loops(program, nb, findings)
+    if not force_scope:
+        findings = [f for f in findings if in_scope(f.rel)]
+    seen = set()
+    out = []
+    for f in findings:
+        if f.key() in seen:
+            continue
+        seen.add(f.key())
+        out.append(f)
+    return out

@@ -1,0 +1,407 @@
+"""Pass 8 — path-sensitive resource lifetime (RL001-RL005, ISSUE 17).
+
+Scope: the session/network plane that ROADMAP item 1's event-loop
+ingest rewrite lands on — all of mastic_tpu/net/, the session and
+party drivers, and the serving/load tools.  Built on the CFG engine
+(`cfg.py`): every function body is lowered to basic blocks with
+explicit raise edges out of every call, and an "open/closed" fact per
+locally-acquired resource is pushed along all paths to fixpoint.
+
+Acquisition sites tracked (bound to a plain local name): socket
+constructors (`socket.socket`, `socket.create_connection`,
+`<ctx>.wrap_socket`, `<listener>.accept()` — first element of a
+tuple unpack), `open(...)`, `subprocess.Popen`, `selectors.*Selector`,
+and the repo's own transport constructors (`TcpListener`,
+`TcpTransport`, `ShapedTransport`, `tcp_dial`).
+
+A resource stops being the function's problem when it is closed
+(settled, for a Popen: wait/communicate/terminate/kill), returned or
+yielded, stored into an attribute/container, passed to another
+callable (the callee is assumed to take ownership — the documented
+intraprocedural blind spot), owned by a `with`, or narrowed away by a
+None-guard (`if sock is not None: sock.close()` prunes the None path).
+`<ctx>.wrap_socket(sock)` transfers ownership on success and — per
+the ssl contract — leaves the plain socket the caller's problem when
+the handshake raises.
+
+  RL001  leak on an exception path: an open resource reaches the
+         uncaught-exception exit (raise edges out of every call).
+  RL002  leak on an early return / fall-through: an open resource
+         reaches the normal exit.
+  RL003  use after close (call on / argument-pass of a resource that
+         is closed on every path reaching the use).
+  RL004  double close without an intervening reopen or a path on
+         which the first close did not happen.
+  RL005  Popen with no wait/terminate/kill/communicate on some path
+         (zombie process) — both exit kinds map here for processes.
+"""
+
+import ast
+
+from . import cfg
+from .core import Finding, call_name
+
+PASS_NAME = "lifetime"
+
+RULES = {
+    "RL001": "resource leaked on an exception path",
+    "RL002": "resource leaked on an early-return/fall-through path",
+    "RL003": "resource used after close",
+    "RL004": "resource closed twice without an intervening reopen",
+    "RL005": "Popen never waited/terminated on some path (zombie)",
+}
+
+SCOPE_PREFIXES = ("mastic_tpu/net/",)
+EXTRA_FILES = ("mastic_tpu/drivers/session.py",
+               "mastic_tpu/drivers/parties.py",
+               "tools/party.py", "tools/serve.py", "tools/loadgen.py")
+
+# klass -> the method names that settle the resource.
+_CLOSERS = {
+    "socket": {"close"},
+    "file": {"close"},
+    "selector": {"close"},
+    "transport": {"close"},
+    "popen": {"wait", "communicate", "terminate", "kill"},
+}
+
+_TRANSPORT_CTORS = {"TcpListener", "TcpTransport", "ShapedTransport",
+                    "tcp_dial"}
+
+
+def in_scope(rel: str) -> bool:
+    return rel.startswith(SCOPE_PREFIXES) or rel in EXTRA_FILES
+
+
+def _acquisition(call: ast.Call):
+    """Resource klass acquired by `call`, or None."""
+    name = call_name(call)
+    if not name:
+        return None
+    tail = name.rsplit(".", 1)[-1]
+    if name == "open":
+        return "file"
+    if name in ("socket.socket", "socket.create_connection") \
+            or tail == "create_connection":
+        return "socket"
+    if tail in ("wrap_socket", "accept"):
+        return "socket"
+    if tail == "Popen":
+        return "popen"
+    if tail.endswith("Selector"):
+        return "selector"
+    if tail in _TRANSPORT_CTORS:
+        return "transport"
+    return None
+
+
+# -- fact plumbing ----------------------------------------------------
+#
+# A fact is ("open"|"closed", name, acquisition line, klass).
+
+def _facts_for(facts, name):
+    return frozenset(f for f in facts if f[1] == name)
+
+
+def _drop(facts, names):
+    if not names:
+        return facts
+    return frozenset(f for f in facts if f[1] not in names)
+
+
+def _escaping_names(element) -> set:
+    """Names the element hands to someone else: call arguments
+    (nested), return/yield values, assignment RHS reads.  Receiver
+    chains (the .func of a Call) do not escape — `sock.recv(n)` uses
+    sock, it does not give it away."""
+    if isinstance(element, ast.expr):
+        return set()                     # branch tests never escape
+    receiver = set()
+    for node in ast.walk(element):
+        if isinstance(node, ast.Call):
+            for sub in ast.walk(node.func):
+                receiver.add(id(sub))
+    out = set()
+    for node in ast.walk(element):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load) \
+                and id(node) not in receiver:
+            out.add(node.id)
+    return out
+
+
+def _bound_names(target) -> set:
+    out = set()
+    for node in ast.walk(target):
+        if isinstance(node, ast.Name):
+            out.add(node.id)
+    return out
+
+
+def _direct_calls(element):
+    """(call, receiver name, attr) for every `name.method(...)` call
+    in the element (direct receiver only — `a.b.method()` is not a
+    use of `a` for RL003/RL004 purposes)."""
+    out = []
+    for node in ast.walk(element) if element is not None else ():
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Name):
+            out.append((node, node.func.value.id, node.func.attr))
+    return out
+
+
+def _none_guard(expr):
+    """(name, kill_edge) for a None/truthiness narrowing test, else
+    None.  kill_edge is the edge kind on which the name is known
+    None/falsy (and its facts die)."""
+    if isinstance(expr, ast.Name):
+        return (expr.id, cfg.FALSE)
+    if isinstance(expr, ast.UnaryOp) and isinstance(expr.op, ast.Not) \
+            and isinstance(expr.operand, ast.Name):
+        return (expr.operand.id, cfg.TRUE)
+    if isinstance(expr, ast.Compare) \
+            and isinstance(expr.left, ast.Name) \
+            and len(expr.ops) == 1 \
+            and len(expr.comparators) == 1 \
+            and isinstance(expr.comparators[0], ast.Constant) \
+            and expr.comparators[0].value is None:
+        if isinstance(expr.ops[0], ast.Is):
+            return (expr.left.id, cfg.TRUE)
+        if isinstance(expr.ops[0], ast.IsNot):
+            return (expr.left.id, cfg.FALSE)
+    return None
+
+
+class _FuncAnalysis:
+    def __init__(self, info, func):
+        self.info = info
+        self.func = func
+        self.graph = cfg.build(func)
+        self.findings = []
+        self._reported = set()
+
+    # -- transfer -----------------------------------------------------
+
+    def transfer(self, block, facts):
+        el = block.elem
+        if el is None:
+            return {cfg.FLOW: facts}
+        if isinstance(el, ast.expr):
+            guard = _none_guard(el)
+            if guard is not None:
+                (name, kill_edge) = guard
+                return {cfg.FLOW: facts,
+                        kill_edge: _drop(facts, {name})}
+            return {cfg.FLOW: facts}
+        if isinstance(el, tuple):
+            if el[0] == "for":
+                node = el[1]
+                out = _drop(facts, _bound_names(node.target))
+                out = _drop(out, _escaping_names(node.iter))
+                return {cfg.FLOW: out, cfg.EXC: facts}
+            if el[0] == "with":
+                return self._with_item(el[1], facts)
+        return self._stmt_transfer(el, facts)
+
+    def _with_item(self, item, facts):
+        expr = item.context_expr
+        out = facts
+        if item.optional_vars is not None:
+            out = _drop(out, _bound_names(item.optional_vars))
+        if isinstance(expr, ast.Name):
+            # `with sock:` — __exit__ closes it on every path out.
+            out = _drop(out, {expr.id})
+        elif isinstance(expr, ast.Call):
+            # Acquisition inside a with-item is owned by the with; any
+            # tracked name passed in escapes to the context manager.
+            out = _drop(out, _escaping_names(ast.Expr(value=expr)))
+        return {cfg.FLOW: out, cfg.EXC: facts}
+
+    def _stmt_transfer(self, st, facts):
+        if isinstance(st, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            return self._assign(st, facts)
+        if isinstance(st, ast.Return):
+            out = _drop(facts, _escaping_names(st))
+            return {cfg.FLOW: out, cfg.EXC: out}
+        if isinstance(st, ast.Delete):
+            names = set()
+            for t in st.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+            return {cfg.FLOW: _drop(facts, names)}
+        if isinstance(st, ast.Expr):
+            return self._expr_stmt(st, facts)
+        if isinstance(st, ast.Raise):
+            out = _drop(facts, _escaping_names(st))
+            return {cfg.FLOW: out, cfg.EXC: out}
+        # assert, import, global, pass, ...
+        return {cfg.FLOW: facts}
+
+    def _close_effects(self, st, facts):
+        """Move open facts to closed for every direct `name.closer()`
+        call in the statement (wherever it appears — `rc = p.wait()`
+        settles p just as `p.wait()` does).  Returns (facts, names
+        closed here)."""
+        closed_here = set()
+        for (_call, recv, attr) in _direct_calls(st):
+            for f in _facts_for(facts, recv):
+                if f[0] == "open" and attr in _CLOSERS.get(f[3], ()):
+                    closed_here.add(recv)
+        out = facts
+        for name in closed_here:
+            moved = {("closed", f[1], f[2], f[3])
+                     for f in _facts_for(facts, name)}
+            out = _drop(out, {name}) | moved
+        return (out, closed_here)
+
+    def _assign(self, st, facts):
+        value = st.value
+        (facts, closed_here) = self._close_effects(st, facts)
+        targets = (st.targets if isinstance(st, ast.Assign)
+                   else [st.target])
+        bound = set()
+        for t in targets:
+            bound |= _bound_names(t)
+        klass = _acquisition(value) if isinstance(value, ast.Call) \
+            else None
+        # The name the new resource binds to: a single plain Name, or
+        # the first element of a tuple unpack for `.accept()`.
+        gen_name = None
+        if klass is not None and len(targets) == 1:
+            t = targets[0]
+            if isinstance(t, ast.Name):
+                gen_name = t.id
+            elif isinstance(t, (ast.Tuple, ast.List)) and t.elts \
+                    and isinstance(t.elts[0], ast.Name) \
+                    and call_name(value).rsplit(".", 1)[-1] == "accept":
+                gen_name = t.elts[0].id
+        escapes = _escaping_names(st) - closed_here
+        out = _drop(facts, bound)
+        out = _drop(out, escapes)
+        if gen_name is not None:
+            out = _drop(out, {gen_name}) \
+                | {("open", gen_name, st.lineno, klass)}
+        # If the statement raises, the binding did not happen and —
+        # for wrap_socket, per the ssl contract — the plain socket
+        # remains the caller's to close.
+        if klass is not None \
+                and call_name(value).rsplit(".", 1)[-1] == "wrap_socket":
+            exc_out = facts
+        else:
+            exc_out = _drop(facts, escapes)
+        return {cfg.FLOW: out, cfg.EXC: exc_out}
+
+    def _expr_stmt(self, st, facts):
+        (out, closed_here) = self._close_effects(st, facts)
+        out = _drop(out, _escaping_names(st) - closed_here)
+        # Kills commit on the raise edge too: a failing close still
+        # counts as cleanup (fd released by the attempt).
+        return {cfg.FLOW: out, cfg.EXC: out}
+
+    # -- reporting ----------------------------------------------------
+
+    def _report(self, rule, line, msg):
+        key = (rule, line, msg)
+        if key not in self._reported:
+            self._reported.add(key)
+            self.findings.append(Finding(rule, self.info.rel, line, msg))
+
+    def run(self):
+        ins = cfg.solve(self.graph, self.transfer)
+        self._report_leaks(ins)
+        self._report_stale_uses(ins)
+        return self.findings
+
+    def _report_leaks(self, ins):
+        fname = self.func.name
+        for (state, name, line, klass) in sorted(
+                ins[self.graph.raise_exit.idx]):
+            if state != "open":
+                continue
+            if klass == "popen":
+                self._report("RL005", line,
+                             f"Popen '{name}' in {fname}() is never "
+                             f"waited/terminated on an exception path "
+                             f"— settle it in a finally or store it")
+            else:
+                self._report("RL001", line,
+                             f"{klass} '{name}' in {fname}() leaks on "
+                             f"an exception path — close it in a "
+                             f"finally, own it with `with`, or "
+                             f"store/return it before calls that can "
+                             f"raise")
+        for (state, name, line, klass) in sorted(
+                ins[self.graph.exit.idx]):
+            if state != "open":
+                continue
+            if klass == "popen":
+                self._report("RL005", line,
+                             f"Popen '{name}' in {fname}() is never "
+                             f"waited/terminated on some path (zombie "
+                             f"process) — wait/terminate before every "
+                             f"return")
+            else:
+                self._report("RL002", line,
+                             f"{klass} '{name}' in {fname}() leaks on "
+                             f"an early-return/fall-through path — "
+                             f"every non-exceptional path must close, "
+                             f"store or return it")
+
+    def _report_stale_uses(self, ins):
+        for block in self.graph.blocks:
+            el = block.elem
+            if el is None or isinstance(el, tuple) \
+                    or not isinstance(el, (ast.stmt, ast.expr)):
+                continue
+            facts = ins[block.idx]
+            if not facts:
+                continue
+            closed = {}
+            still_open = set()
+            for f in facts:
+                if f[0] == "closed":
+                    closed[f[1]] = f
+                else:
+                    still_open.add(f[1])
+            if not closed:
+                continue
+            for (call, recv, attr) in _direct_calls(el):
+                f = closed.get(recv)
+                if f is None or recv in still_open:
+                    continue
+                if attr in _CLOSERS.get(f[3], ()):
+                    if f[3] != "popen":   # second wait() is harmless
+                        self._report(
+                            "RL004", call.lineno,
+                            f"{f[3]} '{recv}' closed twice (already "
+                            f"closed on every path reaching this "
+                            f"close) — drop one, or guard with a "
+                            f"None-out (`x.close(); x = None`)")
+                else:
+                    self._report(
+                        "RL003", call.lineno,
+                        f"{f[3]} '{recv}' used after close (closed on "
+                        f"every path reaching this call)")
+            # Argument-passing a definitely-closed resource.
+            for node in ast.walk(el):
+                if not isinstance(node, ast.Call):
+                    continue
+                for arg in list(node.args) + \
+                        [kw.value for kw in node.keywords]:
+                    if isinstance(arg, ast.Name) \
+                            and arg.id in closed \
+                            and arg.id not in still_open:
+                        f = closed[arg.id]
+                        self._report(
+                            "RL003", node.lineno,
+                            f"{f[3]} '{arg.id}' passed along after "
+                            f"close (closed on every path reaching "
+                            f"this call)")
+
+
+def check(info) -> list:
+    findings = []
+    for node in ast.walk(info.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            findings += _FuncAnalysis(info, node).run()
+    return findings

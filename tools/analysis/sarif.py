@@ -50,10 +50,14 @@ def to_sarif(rule_table: dict, findings: list, suppressed: list,
             }]
         return out
 
-    results = [result(f) for f in findings]
-    for f in suppressed:
-        reason = (reasons or {}).get((f.rel, f.line, f.rule), "")
-        results.append(result(f, sup_reason=reason))
+    # One combined (path, line, rule) order over findings AND
+    # suppressions: runs over identical trees serialize identically,
+    # so CI artifact diffs show real drift, not emission order.
+    tagged = [(f, None) for f in findings]
+    tagged += [(f, (reasons or {}).get((f.rel, f.line, f.rule), ""))
+               for f in suppressed]
+    tagged.sort(key=lambda t: t[0].key())
+    results = [result(f, sup_reason=r) for (f, r) in tagged]
     return {
         "$schema": SARIF_SCHEMA,
         "version": SARIF_VERSION,

@@ -40,7 +40,16 @@ Checks, over mastic_tpu/, tests/, tools/ and the repo-root scripts:
    IDs in tools.analysis._RULE_TABLE — both directions: a shipped
    rule missing from the table is undocumented, a table row whose
    rule no longer exists is stale (the analyzer twin of check 9;
-   the table had only stayed in sync by luck before).
+   the table had only stayed in sync by luck before);
+11. the refusal/shed reason-code contract: every reason literal the
+   code counts into `ServiceCounters.shed_reasons` (via bump_shed /
+   count_front_shed / FrontDoor.shed / shed_external) or into
+   `mastic_tls_refusals_total` (the TLS_* constants in
+   net/transport.py) appears in USAGE.md's reason tables, and every
+   table row names a reason the code still counts — an operator
+   grepping a reason off /statusz must always land on its row
+   (`tls-handshake-failed` and `incomplete-body` had already drifted
+   undocumented before this check existed).
 
 Exit status 0 iff clean.  Run via `make lint` / `make ci`.
 """
@@ -449,6 +458,90 @@ def check_rule_table_docs() -> list:
     return problems
 
 
+# Sinks whose string-literal (or ALL_CAPS-constant) arguments are
+# shed reasons; the TLS refusal vocabulary is the TLS_* constant set
+# in net/transport.py (the reasons reach _count_refusal through
+# exception attributes, so the constants ARE the source of truth).
+_SHED_SINKS = {"bump_shed", "count_front_shed", "shed",
+               "shed_external"}
+_REASON_ROW_RE = re.compile(r"^\|\s*`([a-z0-9]+(?:-[a-z0-9]+)+)`")
+_REASON_SECTIONS = ("## Collector service", "## Network front",
+                    "## Transport security")
+
+
+def _counted_reasons() -> dict:
+    """reason literal -> file that counts it, from the code."""
+    files = sorted((REPO / "mastic_tpu").rglob("*.py"))
+    trees = {}
+    consts: dict = {}      # ALL_CAPS name -> hyphenated str value
+    for path in files:
+        rel = str(path.relative_to(REPO))
+        try:
+            trees[rel] = ast.parse(path.read_text())
+        except SyntaxError:
+            continue  # check 1 reports it
+        for node in trees[rel].body:
+            if isinstance(node, ast.Assign) \
+                    and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id.isupper() \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str) \
+                    and "-" in node.value.value:
+                consts[node.targets[0].id] = node.value.value
+
+    reasons: dict = {}
+    for (rel, tree) in trees.items():
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _SHED_SINKS):
+                continue
+            for arg in node.args:
+                if isinstance(arg, ast.Constant) \
+                        and isinstance(arg.value, str) \
+                        and "-" in arg.value:
+                    reasons.setdefault(arg.value, rel)
+                elif isinstance(arg, ast.Name) \
+                        and arg.id in consts:
+                    reasons.setdefault(consts[arg.id], rel)
+    tls_rel = "mastic_tpu/net/transport.py"
+    for (name, value) in consts.items():
+        if name.startswith("TLS_") and value.startswith("tls-"):
+            reasons.setdefault(value, tls_rel)
+    return reasons
+
+
+def check_reason_docs() -> list:
+    """Check 11: the reason-code contract.  The kebab-case rows of
+    the reason tables in USAGE.md's service/network/transport
+    sections must equal the reason literals the code counts — both
+    directions (same shape as check 10)."""
+    counted = _counted_reasons()
+    usage = (REPO / "USAGE.md").read_text()
+    in_section = False
+    documented = set()
+    for line in usage.splitlines():
+        if line.startswith("## "):
+            in_section = line.startswith(_REASON_SECTIONS)
+            continue
+        if in_section:
+            m = _REASON_ROW_RE.match(line)
+            if m:
+                documented.add(m.group(1))
+    problems = []
+    for reason in sorted(set(counted) - documented):
+        problems.append(
+            f"{counted[reason]}: shed/refusal reason "
+            f"'{reason}' is counted but has no row in USAGE.md's "
+            f"reason tables")
+    for reason in sorted(documented - set(counted)):
+        problems.append(
+            f"USAGE.md: reason-table row '{reason}' names a reason "
+            f"the code no longer counts — remove the stale row")
+    return problems
+
+
 def check_mypy_sync() -> list:
     """Check 8: ANNOTATED == mypy.ini's strict module set, so the
     runtime annotation gate (checks 3/5) covers exactly the modules
@@ -485,6 +578,7 @@ def main() -> int:
     problems += check_mypy_sync()
     problems += check_metric_docs()
     problems += check_rule_table_docs()
+    problems += check_reason_docs()
     for problem in problems:
         print(problem)
     print(f"lint: {len(files)} files, {len(problems)} problem(s)")

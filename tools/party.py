@@ -102,104 +102,112 @@ def serve(args) -> int:
         host, port,
         tls=tls.expecting("collector") if tls else None)
     peer_listener = None
-    if args.peer_listen:
-        (ph, pp) = parse_hostport(args.peer_listen)
-        peer_listener = TcpListener(
-            ph, pp, tls=tls.expecting("helper") if tls else None)
-    if args.port_file:
-        _write_port_file(args.port_file, {
-            "listen": listener.port,
-            "peer_listen": (peer_listener.port
-                            if peer_listener else None)})
-    print(f"party: listening on {host}:{listener.port}"
-          + (f" (peer {ph}:{peer_listener.port})"
-             if peer_listener else "")
-          + (" [mTLS]" if tls else " [plaintext]"),
-          file=sys.stderr, flush=True)
+    # The listeners live in a try/finally from the instant they are
+    # bound: a failed peer-listener bind, a port-file write error or
+    # a crash out of the serve loop must not strand the bound fds
+    # (RL001/RL002).
+    try:
+        if args.peer_listen:
+            (ph, pp) = parse_hostport(args.peer_listen)
+            peer_listener = TcpListener(
+                ph, pp, tls=tls.expecting("helper") if tls else None)
+        if args.port_file:
+            _write_port_file(args.port_file, {
+                "listen": listener.port,
+                "peer_listen": (peer_listener.port
+                                if peer_listener else None)})
+        print(f"party: listening on {host}:{listener.port}"
+              + (f" (peer {ph}:{peer_listener.port})"
+                 if peer_listener else "")
+              + (" [mTLS]" if tls else " [plaintext]"),
+              file=sys.stderr, flush=True)
 
-    restart = None
-    sessions = 0
-    while True:
-        peer = None
-        coll = None
-        try:
-            coll = reliable_accept(listener, "collector", config,
-                                   restart=restart)
-            restart = None
-            raw_cfg = coll.recv_msg("config",
-                                    timeout=config.connect_timeout)
-            cfg = json.loads(raw_cfg)
-            agg_id = cfg["agg_id"]
-            me = "leader" if agg_id == 0 else "helper"
-            injector = (
-                faults_mod.FaultInjector(
-                    faults_mod.parse_faults(cfg["faults"]), me)
-                if cfg.get("faults")
-                else faults_mod.injector_from_env(me))
-            # Arm the already-built channel with this session's
-            # injector (the config that names the faults rides the
-            # very channel they apply to).
-            coll.tp.injector = injector
+        restart = None
+        sessions = 0
+        while True:
+            peer = None
+            coll = None
+            try:
+                coll = reliable_accept(listener, "collector", config,
+                                       restart=restart)
+                restart = None
+                raw_cfg = coll.recv_msg(
+                    "config", timeout=config.connect_timeout)
+                cfg = json.loads(raw_cfg)
+                agg_id = cfg["agg_id"]
+                me = "leader" if agg_id == 0 else "helper"
+                injector = (
+                    faults_mod.FaultInjector(
+                        faults_mod.parse_faults(cfg["faults"]), me)
+                    if cfg.get("faults")
+                    else faults_mod.injector_from_env(me))
+                # Arm the already-built channel with this session's
+                # injector (the config that names the faults rides
+                # the very channel they apply to).
+                coll.tp.injector = injector
 
-            def trace(what: str, _me=me) -> None:
-                obs_trace.event("party_step", party=_me, step=what)
+                def trace(what: str, _me=me) -> None:
+                    obs_trace.event("party_step", party=_me,
+                                    step=what)
 
-            def checkpoint(step: str, _inj=injector) -> None:
-                if _inj is not None:
-                    _inj.checkpoint(step)
+                def checkpoint(step: str, _inj=injector) -> None:
+                    if _inj is not None:
+                        _inj.checkpoint(step)
 
-            checkpoint("spawn")
-            mastic = parties_mod.instantiate(cfg["mastic"])
-            party = parties_mod.AggregatorParty(
-                mastic, agg_id, bytes.fromhex(cfg["verify_key"]),
-                bytes.fromhex(cfg["ctx"]))
-            coll.send_msg(bytes([agg_id]), "hello")
-            trace("engine up (network session)")
-            if agg_id == 0:
-                if peer_listener is None:
-                    raise SessionError(
-                        "collector", "config",
-                        session_mod.KIND_PROTOCOL,
-                        "leader config but no --peer-listen "
-                        "listener to accept the helper on")
-                peer = reliable_accept(peer_listener, "helper",
-                                       config, injector=injector,
-                                       shaper=shaper)
-            else:
-                (peer_host, peer_port) = cfg["peer"]
-                peer = reliable_connect(peer_host, int(peer_port),
-                                        "leader", config, tls=tls,
-                                        injector=injector,
-                                        shaper=shaper)
-            trace("peer channel up")
-            parties_mod._command_loop(party, coll, peer, config,
-                                      injector, trace, checkpoint)
-            sessions += 1
-            print(f"party: session {sessions} complete",
-                  file=sys.stderr, flush=True)
-        except SessionRestart as sr:
-            restart = sr
-            print("party: collector opened a new session; resetting",
-                  file=sys.stderr, flush=True)
-            continue
-        except SessionError as err:
-            # A dead collector or an exhausted redial budget ends
-            # the session attributed; the server survives to take
-            # the next one.
-            print(f"party: session error: {err}", file=sys.stderr,
-                  flush=True)
-            if args.once:
-                return 1
-        finally:
-            for chan in (peer, coll):
-                if chan is not None:
-                    chan.close()
-        if args.once and restart is None:
-            break
-    listener.close()
-    if peer_listener is not None:
-        peer_listener.close()
-    return 0
+                checkpoint("spawn")
+                mastic = parties_mod.instantiate(cfg["mastic"])
+                party = parties_mod.AggregatorParty(
+                    mastic, agg_id, bytes.fromhex(cfg["verify_key"]),
+                    bytes.fromhex(cfg["ctx"]))
+                coll.send_msg(bytes([agg_id]), "hello")
+                trace("engine up (network session)")
+                if agg_id == 0:
+                    if peer_listener is None:
+                        raise SessionError(
+                            "collector", "config",
+                            session_mod.KIND_PROTOCOL,
+                            "leader config but no --peer-listen "
+                            "listener to accept the helper on")
+                    peer = reliable_accept(peer_listener, "helper",
+                                           config,
+                                           injector=injector,
+                                           shaper=shaper)
+                else:
+                    (peer_host, peer_port) = cfg["peer"]
+                    peer = reliable_connect(
+                        peer_host, int(peer_port), "leader", config,
+                        tls=tls, injector=injector, shaper=shaper)
+                trace("peer channel up")
+                parties_mod._command_loop(party, coll, peer, config,
+                                          injector, trace,
+                                          checkpoint)
+                sessions += 1
+                print(f"party: session {sessions} complete",
+                      file=sys.stderr, flush=True)
+            except SessionRestart as sr:
+                restart = sr
+                print("party: collector opened a new session; "
+                      "resetting", file=sys.stderr, flush=True)
+                continue
+            except SessionError as err:
+                # A dead collector or an exhausted redial budget
+                # ends the session attributed; the server survives
+                # to take the next one.
+                print(f"party: session error: {err}",
+                      file=sys.stderr, flush=True)
+                if args.once:
+                    return 1
+            finally:
+                for chan in (peer, coll):
+                    if chan is not None:
+                        chan.close()
+            if args.once and restart is None:
+                break
+        return 0
+    finally:
+        listener.close()
+        if peer_listener is not None:
+            peer_listener.close()
 
 
 def main() -> int:
