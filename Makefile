@@ -33,6 +33,14 @@
 #                epoch drill (tools/serve.py --overlap-drill:
 #                concurrent submit burst through the ingest front +
 #                kill-9 + --resume with MASTIC_SERVICE_OVERLAP=2)
+#   make wal-smoke  durability gate (ISSUE 18): the admission-WAL
+#                tests of tests/test_wal.py (torn-tail boundary
+#                matrix, group-commit ack-after-fsync, ENOSPC
+#                brownout over real HTTP, snapshot-vs-WAL dedup),
+#                then tools/serve.py --wal-drill — kill-9 at every
+#                WAL checkpoint plus seeded disk-fault schedules,
+#                bit-identity + zero lost acks + recovery
+#                attribution asserted (USAGE.md "Durability")
 #   make chaos-smoke  transport-security gate (ISSUE 14): the fast
 #                reconnect / mTLS-negative-matrix / idle-timeout
 #                tests of tests/test_net.py, then a seeded
@@ -84,13 +92,13 @@
 PY ?= python
 
 .PHONY: ci lint analyze faults serve-smoke net-smoke chaos-smoke \
-	obs-smoke pipeline artifacts-smoke multichip typecheck \
-	test-fast test test-slow test-slow-1 test-slow-2 test-slow-3 \
-	bench
+	wal-smoke obs-smoke pipeline artifacts-smoke multichip \
+	typecheck test-fast test test-slow test-slow-1 test-slow-2 \
+	test-slow-3 bench
 
 ci: lint analyze faults serve-smoke net-smoke chaos-smoke \
-	obs-smoke pipeline artifacts-smoke multichip typecheck \
-	test-fast
+	wal-smoke obs-smoke pipeline artifacts-smoke multichip \
+	typecheck test-fast
 
 faults:
 	$(PY) -m pytest tests/test_faults.py -q -m "not slow"
@@ -122,6 +130,14 @@ chaos-smoke:
 	$(PY) -m pytest tests/test_net.py -q -m "not slow" \
 		-k "mtls or reliable or reconnect or partition or idle_timeout or tls_config or recv_timeout"
 	JAX_PLATFORMS=cpu $(PY) tools/serve.py --chaos-drill 7 --chaos-seeds 3
+
+# The durability gate (ISSUE 18): fast WAL tests (no compile), then
+# the disk-fault leg of the chaos campaign — kill-9 at every WAL
+# checkpoint and seeded kill/short_write/enospc schedules, each run
+# proven bit-identical with exactly the clean run's admissions.
+wal-smoke:
+	$(PY) -m pytest tests/test_wal.py -q -m "not slow"
+	JAX_PLATFORMS=cpu $(PY) tools/serve.py --wal-drill 7 --wal-seeds 3
 
 # The status-port smoke reuses serve.py --smoke's scenario with the
 # HTTP surface armed: the run itself curls /metrics, /statusz and
@@ -169,7 +185,8 @@ test-fast:
 		--ignore=tests/test_obs.py \
 		--ignore=tests/test_pipeline.py \
 		--ignore=tests/test_artifacts.py \
-		--ignore=tests/test_mesh_pipeline.py
+		--ignore=tests/test_mesh_pipeline.py \
+		--ignore=tests/test_wal.py
 
 test-slow:
 	$(PY) -m pytest tests/ -q -m "slow"

@@ -64,6 +64,7 @@ import numpy as np
 
 from ..obs import trace as obs_trace
 from ..obs.registry import get_registry
+from . import wal as wal_mod
 
 ARTIFACT_VERSION = 1
 MANIFEST_NAME = "manifest.json"
@@ -282,12 +283,19 @@ class ArtifactStore:
         return man
 
     def _write_manifest(self) -> None:
+        # Crash-safe manifest (ISSUE 18 / RB006): tmp → fsync(file) →
+        # atomic rename → fsync(dir), so a power cut never leaves a
+        # half-written manifest NOR a rename whose bytes are still in
+        # the page cache.
         os.makedirs(self.path, exist_ok=True)
         tmp = os.path.join(self.path, MANIFEST_NAME + ".tmp")
         with open(tmp, "w") as fh:
             json.dump(self.manifest, fh, indent=1, sort_keys=True)
             fh.write("\n")
+            fh.flush()
+            os.fsync(fh.fileno())
         os.replace(tmp, os.path.join(self.path, MANIFEST_NAME))
+        wal_mod.fsync_dir(self.path)
 
     def keys(self) -> list:
         """Every manifest entry's program key, as tuples."""
@@ -345,13 +353,22 @@ class ArtifactStore:
                           if stablehlo else None),
         }
         os.makedirs(os.path.join(self.path, "blobs"), exist_ok=True)
-        with open(os.path.join(self.path, entry["blob"]), "wb") as f:
+        # The blob must be durable BEFORE the manifest names it
+        # (ISSUE 18): a manifest entry pointing at unsynced bytes
+        # would fail its sha256 gate on the next load after a crash.
+        blob_path = os.path.join(self.path, entry["blob"])
+        with open(blob_path, "wb") as f:
             f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        wal_mod.fsync_dir(os.path.dirname(blob_path))
         if stablehlo:
             os.makedirs(os.path.join(self.path, "hlo"), exist_ok=True)
             with open(os.path.join(self.path, entry["stablehlo"]),
                       "wb") as f:
                 f.write(stablehlo)
+                f.flush()
+                os.fsync(f.fileno())
         self.manifest["version"] = ARTIFACT_VERSION
         self.manifest["runtime"] = runtime_tag()
         self.manifest["entries"][name] = entry

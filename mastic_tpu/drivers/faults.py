@@ -56,6 +56,18 @@ loss — and the ``tls_handshake`` checkpoint fires in the dial/accept
 paths (kill/hang/delay a handshake).  `tools/serve.py --chaos-drill`
 composes a seeded random schedule out of exactly this vocabulary.
 
+ISSUE 18 reaches durable storage: the admission WAL
+(drivers/wal.py, party=collector) fires the ``on_disk`` content seam
+at ``wal_append`` (per record write) and ``wal_fsync`` (per fsync),
+where the disk actions live — ``short_write`` (the record lands
+`cut` bytes short and the process dies before fsync: a torn tail
+recovery must truncate-and-count), ``enospc`` and ``fsync_error``
+(raise, flipping ingest to the reason-coded `wal-full`/`wal-degraded`
+brownout), plus ``corrupt`` as a post-checksum bit-flip — and the
+plain ``wal_ack`` checkpoint fires after fsync and before the ack
+(kill there leaves a durable-but-unacked record the client will
+retry, which recovery's digest dedup must ack idempotently).
+
 Each process parses `MASTIC_FAULTS` itself and keeps only the rules
 addressed to its own party name, so one env var arms the whole
 session (the collector passes it through to the party processes).
@@ -77,12 +89,21 @@ ACTIONS = ("drop", "delay", "truncate", "corrupt", "duplicate",
            # seam, FaultInjector.on_net): a dropped connection, a
            # partition lasting `delay` seconds both directions, and
            # a writer that stalls mid-frame for `delay` seconds.
-           "conn_drop", "partition", "slow_loris")
+           "conn_drop", "partition", "slow_loris",
+           # ISSUE 18 disk-fault actions (the WAL seam,
+           # FaultInjector.on_disk): a write that lands `cut` bytes
+           # short and dies before fsync (torn tail), a full disk,
+           # and an fsync that errors.
+           "short_write", "enospc", "fsync_error")
 PARTIES = ("leader", "helper", "collector")
 
 # The actions only the reliable-transport seam implements (a plain
 # channel cannot recover from them; the TcpTransport reconnects).
 NET_ACTIONS = ("conn_drop", "partition", "slow_loris")
+
+# The actions only the durable-storage seam implements
+# (FaultInjector.on_disk — the WAL append/fsync path).
+DISK_ACTIONS = ("short_write", "enospc", "fsync_error")
 
 # `hang` sleeps this long — far past any configured deadline, short
 # enough that an orphaned hung process eventually dies on its own.
@@ -292,6 +313,47 @@ class FaultInjector:
             mutated = bytearray(blob)
             mutated[off] ^= (rule.xor or 0x01)
             return bytes(mutated)
+        raise ValueError(
+            f"fault action {rule.action!r} does not apply to "
+            f"step {step!r}")
+
+    def on_disk(self, step: str, data: bytes) -> tuple:
+        """Durable-storage seam (ISSUE 18): fired by the admission
+        WAL once per write (`wal_append`, `data` = the encoded
+        record) and once per fsync (`wal_fsync`, `data` empty).
+        Returns ``(bytes_to_write, after)`` where `after` is
+        ``"kill"`` when the process must die immediately after the
+        (possibly shortened) bytes reach the OS — the short-write/
+        torn-tail fault, which recovery must truncate-and-count.
+        ``enospc``/``fsync_error`` raise the matching OSError so the
+        WAL's reason-coded brownout path runs; ``corrupt`` flips a
+        byte AFTER the record's CRC was computed (recovery must
+        detect, attribute, and skip — never admit garbage);
+        kill/hang/delay behave as at any checkpoint."""
+        import errno
+
+        rule = self._match(step)
+        if rule is None:
+            return (data, None)
+        if rule.action == "kill":
+            os._exit(KILL_EXIT_CODE)
+        if rule.action == "hang":
+            time.sleep(HANG_SECONDS)
+            return (data, None)
+        if rule.action == "delay":
+            time.sleep(rule.delay)
+            return (data, None)
+        if rule.action == "short_write":
+            return (data[:max(0, len(data) - rule.cut)], "kill")
+        if rule.action == "enospc":
+            raise OSError(errno.ENOSPC, "injected ENOSPC")
+        if rule.action == "fsync_error":
+            raise OSError(errno.EIO, "injected fsync failure")
+        if rule.action == "corrupt":
+            off = min(rule.offset, len(data) - 1)
+            mutated = bytearray(data)
+            mutated[off] ^= (rule.xor or 0x01)
+            return (bytes(mutated), None)
         raise ValueError(
             f"fault action {rule.action!r} does not apply to "
             f"step {step!r}")

@@ -14,6 +14,7 @@ present in the dict, else the default (reference examples.py:26-34,
 spec draft-mouris-cfrg-mastic.md:1535-1572).
 """
 
+import os
 import time
 from typing import Optional, Sequence
 
@@ -866,6 +867,25 @@ class RoundPrograms:
     # ArtifactStore.save refuses donating executables outright.
     _donate_carries = True
 
+    def _donation_safe(self) -> bool:
+        """Donation is only safe when the executable can never come
+        back DESERIALIZED.  The artifact store enforces that by
+        refusing donating executables (PERF.md §11), but jax's own
+        persistent compilation cache (JAX_COMPILATION_CACHE_DIR)
+        deserializes jitted executables on a hit behind our back —
+        same double-free, different loader.  Observed live in the WAL
+        kill-9 drill: a restarted collector whose level-0 eval came
+        from the warm shared cache corrupted the heap, and the FLP
+        weight check then rejected every report (or segfaulted at
+        teardown) while a cold-compiling child never failed.  So:
+        drop donation whenever the persistent cache is configured."""
+        if not self._donate_carries:
+            return False
+        cache_dir = (getattr(jax.config, "jax_compilation_cache_dir",
+                             None)
+                     or os.environ.get("JAX_COMPILATION_CACHE_DIR"))
+        return not cache_dir
+
     def _eval_jit(self):
         if self._eval_fn is None:
             engine = self.engine
@@ -888,7 +908,7 @@ class RoundPrograms:
             # eval -> combine handoff has deterministic shardings (the
             # AOT warm lowers against exactly these).
             kwargs: dict = {}
-            if self._donate_carries:
+            if self._donation_safe():
                 kwargs["donate_argnums"] = (1, 2)
             if self.mesh is not None:
                 rep = self._rep_sharding()

@@ -484,7 +484,7 @@ class _Tenant:
                  "suspended", "last_timeline", "lock",
                  "eff_page_size", "eff_max_buffered",
                  "eff_quarantine_limit", "eff_epoch_deadline",
-                 "eff_shed_policy")
+                 "eff_shed_policy", "replay_digests")
 
     def __init__(self, spec: TenantSpec, config: ServiceConfig):
         self.spec = spec
@@ -520,6 +520,13 @@ class _Tenant:
             spec.epoch_deadline if spec.epoch_deadline is not None
             else config.epoch_deadline)
         self.eff_shed_policy = config.shed_policy
+        # SHA-256 digests of reports the WAL replayed at recovery
+        # (ISSUE 18): a client retrying an upload that was durable
+        # but never acked lands here and gets an idempotent ADMITTED
+        # ack instead of a duplicate buffer entry.  Empty except in
+        # a freshly recovered process, so the hot path costs one
+        # truthiness check.
+        self.replay_digests: set = set()
 
     def buffered_reports(self) -> int:
         """Reports the tenant holds admitted-but-unfinished — the
@@ -838,6 +845,16 @@ class CollectorService:
         counters).  Never raises for bad input — a hostile upload
         must cost the service one decode, not an exception path."""
         t = self.tenants[tenant]
+        if t.replay_digests:
+            # Post-recovery only (ISSUE 18): a retry of an upload the
+            # WAL already replayed must ack exactly-once, not buffer
+            # a duplicate.
+            digest = hashlib.sha256(blob).digest()
+            with t.lock:
+                duplicate = digest in t.replay_digests
+            if duplicate:
+                obs_trace.event("duplicate_ack", tenant=tenant)
+                return (ADMITTED, "duplicate")
         if self._ingest is not None:
             # The front path: enqueue only.  submit() never blocks on
             # decode OR round execution; a full queue is explicit
@@ -856,6 +873,35 @@ class CollectorService:
         story.  Unknown tenants can't reach here (the front 404s
         before a ledger exists to blame)."""
         self.tenants[tenant].count_front_shed(reason, n)
+
+    def report_digests(self, tenant: str) -> set:
+        """SHA-256 digests of every upload blob the tenant currently
+        buffers (open page, sealed pages, queued and active epochs) —
+        the WAL recovery dedup baseline: a record both in the restored
+        snapshot and in the log must not be buffered twice.  Pages
+        failing their digest check contribute nothing (their reports
+        are already lost to the corruption-drop path)."""
+        t = self.tenants[tenant]
+        with t.lock:
+            pages = [t.open_page] + list(t.sealed)
+            for ep in t.pending:
+                pages.extend(ep.pages)
+            if t.active is not None:
+                pages.extend(t.active.pages)
+            digests = set()
+            for page in pages:
+                if not page.verify():
+                    continue
+                for blob in page.decode_blobs():
+                    digests.add(hashlib.sha256(blob).digest())
+        return digests
+
+    def note_replayed(self, tenant: str, digest: bytes) -> None:
+        """Register one WAL-replayed report digest for retry dedup
+        (see `_Tenant.replay_digests`)."""
+        t = self.tenants[tenant]
+        with t.lock:
+            t.replay_digests.add(digest)
 
     def _ingest_one(self, tenant: str, blob: bytes) -> tuple:
         """Decode-validate one upload and land the verdict — the

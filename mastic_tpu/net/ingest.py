@@ -22,6 +22,11 @@ status code, never a body it must parse to learn success):
                         + Retry-After     (quota, queue-full, rate)
     503 overloaded      {"error": "shed", "reason":
                         "connections-exhausted"} + Retry-After
+    503 brownout        {"error": "shed", "reason": "wal-full" |
+                        "wal-degraded"} + Retry-After  (ISSUE 18: the
+                        admission WAL cannot make the upload durable —
+                        ENOSPC / fsync failure; reads and status keep
+                        serving, acked reports stay safe)
 
 Every error body is structured JSON built from FIXED strings, the r8
 reason-code names and integer limits — nothing derived from tenant
@@ -62,6 +67,8 @@ class _IdleTimeout(Exception):
 
 from ..drivers import faults as faults_mod
 from ..drivers.service import ADMITTED, QUARANTINED, QUEUED, SHED
+from ..drivers.wal import (REASON_WAL_DEGRADED, REASON_WAL_FULL,
+                           WalUnavailable)
 from ..obs import trace as obs_trace
 from ..obs.registry import get_registry
 from .admission import (AdmissionController, NetConfig,
@@ -316,6 +323,26 @@ class _UploadHandler(BaseHTTPRequestHandler):
             # attributed reason — never admit.
             body = front.injector.on_blob("http_body", body)
 
+        if front._persist is not None:
+            # Durability gate (ISSUE 18): the upload body goes into
+            # the admission WAL and this thread blocks until its
+            # record's fsync — BEFORE submit, so a failed append is
+            # a clean reason-coded 503 with no half-admitted state,
+            # and a crash after this point leaves a record recovery
+            # replays (the client's retry then acks idempotently).
+            try:
+                front._persist(tenant, body)
+            except WalUnavailable as exc:
+                if exc.reason == REASON_WAL_FULL:
+                    front.shed(tenant, REASON_WAL_FULL)
+                    return (503, {"error": "shed",
+                                  "reason": REASON_WAL_FULL},
+                            exc.retry_after)
+                front.shed(tenant, REASON_WAL_DEGRADED)
+                return (503, {"error": "shed",
+                              "reason": REASON_WAL_DEGRADED},
+                        exc.retry_after)
+
         (status, detail) = front.service.submit(tenant, body)
         code = _STATUS_CODES[status]
         if status in (ADMITTED, QUEUED):
@@ -339,7 +366,7 @@ class UploadFront:
     def __init__(self, service, config: Optional[NetConfig] = None,
                  port: int = 0, host: str = "127.0.0.1",
                  injector=None, admin: bool = False,
-                 on_admitted=None, registry=None):
+                 on_admitted=None, registry=None, persist=None):
         self.service = service
         # `cfg`, not `config`: see AdmissionController — attr-name
         # aliasing with jax.config would muddy the CC001 model.
@@ -355,6 +382,11 @@ class UploadFront:
         self.host = host
         self.port: Optional[int] = None
         self._on_admitted = on_admitted
+        # Durability gate (ISSUE 18): `(tenant, body) -> None`,
+        # called before submit(); blocks until the upload is
+        # fsync-durable, raises WalUnavailable for the reason-coded
+        # brownout 503.  serve.py passes AdmissionWal.append_report.
+        self._persist = persist
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         # Epoch-cut requests the admin endpoint queued (BOUNDED: a

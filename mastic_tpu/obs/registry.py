@@ -136,6 +136,21 @@ DECLARED = {
     "mastic_net_active_connections":
         ("gauge", "upload-front requests currently being served "
          "(bounded by MASTIC_NET_MAX_CONNS)", ()),
+    "mastic_wal_appends_total":
+        ("counter", "admission-WAL records appended and made "
+         "durable, by tenant and record kind (report/epoch_cut; "
+         "mastic_tpu/drivers/wal.py)", ("tenant", "kind")),
+    "mastic_wal_fsync_ms":
+        ("histogram", "per-ack durability wait: append start to "
+         "fsync-confirmed, milliseconds (group commit batches "
+         "these)", ()),
+    "mastic_wal_recovered_records_total":
+        ("counter", "WAL records handled at recovery, by outcome "
+         "(replayed/covered/deduped/torn_tail/corrupt/epoch_cut/"
+         "rejected)", ("outcome",)),
+    "mastic_wal_segment_bytes":
+        ("gauge", "bytes in the WAL's current open segment (resets "
+         "on rotation at MASTIC_WAL_SEGMENT_BYTES)", ()),
 }
 
 

@@ -1,0 +1,18 @@
+"""Known-bad: publish-by-rename without durability (RB006) — the
+rename lands atomically but nothing forced the tmp file's bytes to
+disk first, so a crash can leave an empty or torn file under the
+final name."""
+
+import json
+import os
+
+
+def publish_snapshot(path, state):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(state, f)            # buffered, never fsynced
+    os.replace(tmp, path)
+
+
+def rotate_log(path):
+    os.rename(path, path + ".1")       # same hazard, rename spelling

@@ -1,0 +1,24 @@
+"""Known-good: the tmp-write → fsync(file) → replace → fsync(dir)
+idiom (RB006) — the bytes are durable before the name points at
+them, and the directory entry itself is durable after."""
+
+import json
+import os
+
+
+def fsync_dir(path):
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def publish_snapshot(path, state):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(state, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    fsync_dir(os.path.dirname(os.path.abspath(path)))

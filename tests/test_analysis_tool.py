@@ -35,6 +35,7 @@ CASES = {
     "RB003": ("rb003_bad.py", "rb003_good.py", "robustness"),
     "RB004": ("rb004_bad.py", "rb004_good.py", "robustness"),
     "RB005": ("rb005_bad.py", "rb005_good.py", "robustness"),
+    "RB006": ("rb006_bad.py", "rb006_good.py", "robustness"),
     "OB001": ("ob001_bad.py", "ob001_good.py", "observability"),
     "CC001": ("cc001_bad.py", "cc001_good.py", "concurrency"),
     "CC002": ("cc002_bad.py", "cc002_good.py", "concurrency"),

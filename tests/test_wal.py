@@ -1,0 +1,365 @@
+"""The durable admission WAL (ISSUE 18, `mastic_tpu/drivers/wal.py`):
+record round-trip, segment rotation and compaction, the torn-tail
+byte-boundary matrix, post-checksum corruption attribution, the
+group-commit ack-after-fsync contract, the ENOSPC/fsync-failure
+reason-coded brownout over real HTTP, and WAL-vs-snapshot dedup on
+double-covered reports.
+
+Fast tier throughout: the HTTP tests stop short of a page seal, so
+nothing here triggers an XLA compile — the WAL sits strictly under
+admission (decode + log + page append)."""
+
+import json
+import os
+import time
+from http.client import HTTPConnection
+
+import pytest
+
+from mastic_tpu.drivers import faults
+from mastic_tpu.drivers.service import (CollectorService,
+                                        ServiceConfig, TenantSpec)
+from mastic_tpu.drivers.wal import (AdmissionWal, REASON_WAL_DEGRADED,
+                                    REASON_WAL_FULL, WalConfig,
+                                    WalUnavailable)
+from mastic_tpu.mastic import MasticCount
+from mastic_tpu.net import loadgen as loadgen_mod
+from mastic_tpu.net.admission import NetConfig
+from mastic_tpu.net.ingest import MEDIA_TYPE, UploadFront
+from mastic_tpu.obs.registry import configure as configure_registry
+
+CTX = b"wal test"
+BITS = 2
+
+ALWAYS = WalConfig(fsync="always")
+
+
+def make_service(**over) -> tuple:
+    m = MasticCount(BITS)
+    vk = bytes(range(m.VERIFY_KEY_SIZE))
+    spec = TenantSpec(name="count",
+                      spec={"class": "MasticCount", "args": [BITS]},
+                      ctx=CTX, verify_key=vk,
+                      thresholds={"default": 1})
+    defaults = dict(page_size=4, max_buffered=64,
+                    epoch_deadline=600.0)
+    defaults.update(over)
+    svc = CollectorService([spec], config=ServiceConfig(**defaults))
+    return (svc, m)
+
+
+def put(port: int, path: str, body: bytes) -> tuple:
+    conn = HTTPConnection("127.0.0.1", port, timeout=10.0)
+    try:
+        conn.request("PUT", path, body=body,
+                     headers={"Content-Type": MEDIA_TYPE})
+        resp = conn.getresponse()
+        data = resp.read()
+        return (resp.status, json.loads(data),
+                dict(resp.getheaders()))
+    finally:
+        conn.close()
+
+
+class FakeService:
+    """Replay target that records submissions in order — enough of
+    the CollectorService surface for recover(): `tenants`,
+    `submit`, `report_digests`, `note_replayed`, `begin_epoch`."""
+
+    def __init__(self, tenants=("count",), baseline=()):
+        self.tenants = {t: object() for t in tenants}
+        self.calls: list = []
+        self.replayed: set = set()
+        self._baseline = set(baseline)
+
+    def submit(self, tenant, blob):
+        self.calls.append(("submit", tenant, blob))
+        return ("admitted", "")
+
+    def report_digests(self, tenant):
+        return set(self._baseline)
+
+    def note_replayed(self, tenant, digest):
+        self.replayed.add(digest)
+
+    def begin_epoch(self, tenant):
+        self.calls.append(("epoch", tenant, None))
+
+
+def injector(spec: str) -> faults.FaultInjector:
+    return faults.FaultInjector(faults.parse_faults(spec),
+                                "collector")
+
+
+# -- round-trip, rotation, compaction ---------------------------------
+
+def test_roundtrip_replays_bit_identical(tmp_path):
+    configure_registry()
+    d = str(tmp_path / "wal")
+    w = AdmissionWal(d, config=ALWAYS, fresh=True)
+    blobs = [b"report-%d" % i for i in range(5)]
+    for b in blobs:
+        w.append_report("count", b)
+    w.append_epoch_cut("count")
+    w.append_report("count", b"after-cut")
+    w.close()
+
+    svc = FakeService()
+    w2 = AdmissionWal(d, config=ALWAYS)
+    counts = w2.recover(svc)
+    assert counts["replayed"] == 6 and counts["epoch_cut"] == 1
+    assert counts["torn_tail"] == 0 and counts["corrupt"] == 0
+    assert [c[2] for c in svc.calls] == blobs + [None, b"after-cut"]
+    assert svc.calls[5] == ("epoch", "count", None)
+    # The watermark continues where the log left off.
+    assert w2.append_report("count", b"next") == 7
+    w2.close()
+
+
+def test_append_before_recover_on_existing_dir_refused(tmp_path):
+    d = str(tmp_path / "wal")
+    w = AdmissionWal(d, config=ALWAYS, fresh=True)
+    w.append_report("count", b"x")
+    w.close()
+    w2 = AdmissionWal(d, config=ALWAYS)
+    with pytest.raises(RuntimeError):
+        w2.append_report("count", b"y")
+    w2.close()
+
+
+def test_rotation_and_compaction(tmp_path):
+    configure_registry()
+    d = str(tmp_path / "wal")
+    w = AdmissionWal(d, config=WalConfig(fsync="always",
+                                         segment_bytes=1),
+                     fresh=True)
+    for i in range(4):
+        w.append_report("count", b"r%d" % i)
+    segs = sorted(n for n in os.listdir(d) if n.endswith(".seg"))
+    assert len(segs) == 4          # 1-byte cap: every append rotates
+    # A snapshot covering seq<=2 drops the sealed segments up to it
+    # but never the live one.
+    dropped = w.mark_covered(2, "deadbeef")
+    assert dropped == 3
+    remaining = sorted(n for n in os.listdir(d)
+                       if n.endswith(".seg"))
+    assert remaining == segs[3:]
+    w.close()
+
+    # Marker trusted (digest matches): only the uncovered record
+    # replays.  Marker distrusted (digest differs): everything still
+    # on disk replays — dedup falls back to the content digests.
+    svc = FakeService()
+    w2 = AdmissionWal(d, config=ALWAYS)
+    counts = w2.recover(svc, snapshot_sha256="deadbeef")
+    assert counts["replayed"] == 1 and counts["covered"] == 0
+    assert [c[2] for c in svc.calls] == [b"r3"]
+    w2.close()
+
+
+def test_distrusted_marker_falls_back_to_digest_dedup(tmp_path):
+    """A covered.json naming a DIFFERENT snapshot digest than the one
+    actually restored must not be trusted: replay consults the
+    service's report digests instead, so double-covered reports dedup
+    rather than re-buffer (satellite: re-verify the snapshot digest
+    before preferring it over replay)."""
+    configure_registry()
+    d = str(tmp_path / "wal")
+    w = AdmissionWal(d, config=ALWAYS, fresh=True)
+    w.append_report("count", b"covered-by-snapshot")
+    w.append_report("count", b"not-in-snapshot")
+    w.mark_covered(0, "digest-of-a-snapshot-we-do-NOT-have")
+    w.close()
+
+    from hashlib import sha256
+    svc = FakeService(
+        baseline=[sha256(b"covered-by-snapshot").digest()])
+    w2 = AdmissionWal(d, config=ALWAYS)
+    counts = w2.recover(svc, snapshot_sha256="something-else")
+    assert counts["deduped"] == 1 and counts["replayed"] == 1
+    assert counts["covered"] == 0
+    assert [c[2] for c in svc.calls] == [b"not-in-snapshot"]
+    # The deduped digest is armed for idempotent client retries.
+    assert sha256(b"covered-by-snapshot").digest() in svc.replayed
+    w2.close()
+
+
+# -- torn tails and corruption ----------------------------------------
+
+def test_torn_tail_byte_boundary_matrix(tmp_path):
+    """Truncate the segment at EVERY byte boundary inside the last
+    record: recovery must land on the exact good prefix (2 replayed,
+    1 torn tail) at every cut, and on the clean boundary itself the
+    tail is whole (no torn count)."""
+    configure_registry()
+    base = str(tmp_path / "base")
+    w = AdmissionWal(base, config=ALWAYS, fresh=True)
+    sizes = []
+    seg = None
+    for i in range(3):
+        w.append_report("count", b"record-%d" % i)
+        seg = w._seg_path
+        sizes.append(os.path.getsize(seg))
+    w.close()
+    seg_name = os.path.basename(seg)
+
+    import shutil
+    for cut in range(sizes[1], sizes[2]):
+        d = str(tmp_path / f"cut{cut}")
+        shutil.copytree(base, d)
+        os.truncate(os.path.join(d, seg_name), cut)
+        svc = FakeService()
+        w2 = AdmissionWal(d, config=ALWAYS)
+        counts = w2.recover(svc)
+        torn = 0 if cut == sizes[1] else 1
+        assert (counts["replayed"], counts["torn_tail"]) == (2, torn), \
+            f"cut={cut}: {counts}"
+        assert [c[2] for c in svc.calls] == [b"record-0", b"record-1"]
+        # The torn bytes are truncated away: a subsequent append must
+        # start a valid record at the new tail, and a second recovery
+        # sees a clean log.
+        assert w2.append_report("count", b"record-2b") == 2
+        w2.close()
+        w3 = AdmissionWal(d, config=ALWAYS)
+        again = w3.recover(FakeService())
+        assert (again["replayed"], again["torn_tail"]) == (3, 0)
+        w3.close()
+
+
+def test_post_checksum_corruption_detected_and_skipped(tmp_path):
+    """A bit flipped AFTER the CRC was computed (the on_disk corrupt
+    fault) must be detected, counted, and skipped — never replayed as
+    garbage, never refusing the rest of the log."""
+    configure_registry()
+    d = str(tmp_path / "wal")
+    inj = injector("corrupt:party=collector:step=wal_append:nth=2"
+                   ":offset=20:xor=1")
+    w = AdmissionWal(d, config=ALWAYS, injector=inj, fresh=True)
+    for i in range(3):
+        w.append_report("count", b"record-%d" % i)
+    w.close()
+    svc = FakeService()
+    w2 = AdmissionWal(d, config=ALWAYS)
+    counts = w2.recover(svc)
+    assert counts["corrupt"] == 1 and counts["replayed"] == 2
+    assert [c[2] for c in svc.calls] == [b"record-0", b"record-2"]
+    w2.close()
+
+
+# -- the ack-after-fsync contract -------------------------------------
+
+def test_group_commit_ack_waits_for_fsync(tmp_path):
+    """With the group committer's fsync delayed by injection, the
+    append call must not return before the delayed fsync completes —
+    an ack can never precede durability."""
+    configure_registry()
+    delay = 0.3
+    inj = injector(f"delay:party=collector:step=wal_fsync"
+                   f":delay={delay}")
+    w = AdmissionWal(str(tmp_path / "wal"),
+                     config=WalConfig(fsync="group", group_ms=5.0),
+                     injector=inj, fresh=True)
+    t0 = time.monotonic()
+    w.append_report("count", b"must-wait")
+    waited = time.monotonic() - t0
+    assert waited >= delay, \
+        f"ack returned after {waited:.3f}s, fsync held {delay}s"
+    stats = w.stats()
+    assert stats["appends"] == 1
+    assert stats["fsync_wait_ms_p99"] >= delay * 1000.0
+    w.close()
+
+
+def test_fsync_failure_degrades_then_heals(tmp_path):
+    """An fsync error must fail the append (no ack on a lie) and flip
+    the log to the reason-coded degraded state; the next append heals
+    by rotating to a fresh segment."""
+    configure_registry()
+    inj = injector("fsync_error:party=collector:step=wal_fsync:nth=1")
+    w = AdmissionWal(str(tmp_path / "wal"), config=ALWAYS,
+                     injector=inj, fresh=True)
+    with pytest.raises(WalUnavailable) as ei:
+        w.append_report("count", b"doomed")
+    assert ei.value.reason == REASON_WAL_DEGRADED
+    assert ei.value.retry_after >= 1
+    assert w.stats()["degraded"] == REASON_WAL_DEGRADED
+    # nth=1 consumed: the retry rotates to a fresh segment and lands.
+    assert w.append_report("count", b"healed") >= 0
+    assert w.stats()["degraded"] is None
+    w.close()
+
+
+# -- brownout over real HTTP ------------------------------------------
+
+def test_enospc_brownout_and_recover_over_http(tmp_path):
+    """Injected ENOSPC on the WAL write: the upload gets a 503 with
+    the `wal-full` reason and a Retry-After; the retry (disk space
+    'freed' — the fault is one-shot) is admitted; the shed reason is
+    counted on the tenant."""
+    configure_registry()
+    (svc, m) = make_service()
+    inj = injector("enospc:party=collector:step=wal_append:nth=3")
+    wal = AdmissionWal(str(tmp_path / "wal"), config=ALWAYS,
+                       injector=inj, fresh=True)
+    front = UploadFront(svc, config=NetConfig(),
+                        persist=wal.append_report).start()
+    try:
+        blobs = loadgen_mod.build_blob_pool(m, CTX, 3, BITS,
+                                            replay=1)
+        for b in blobs[:2]:
+            (code, body, _h) = put(front.port,
+                                   "/v1/tenants/count/reports", b)
+            assert (code, body) == (201, {"status": "admitted"})
+        (code, body, headers) = put(front.port,
+                                    "/v1/tenants/count/reports",
+                                    blobs[2])
+        assert code == 503
+        assert body == {"error": "shed", "reason": REASON_WAL_FULL}
+        assert int(headers["Retry-After"]) >= 1
+        # Nothing half-admitted: the failed upload is not buffered.
+        c = svc.metrics()["tenants"]["count"]["counters"]
+        assert c["admitted"] == 2
+        assert c["shed_reasons"] == {REASON_WAL_FULL: 1}
+        # Retry lands (brownout healed by segment rotation).
+        (code, body, _h) = put(front.port,
+                               "/v1/tenants/count/reports", blobs[2])
+        assert (code, body) == (201, {"status": "admitted"})
+        assert svc.metrics()["tenants"]["count"]["counters"][
+            "admitted"] == 3
+    finally:
+        front.stop()
+        wal.close()
+    # Everything acked is in the log — replay proves it.
+    svc2 = FakeService()
+    w2 = AdmissionWal(str(tmp_path / "wal"), config=ALWAYS)
+    assert w2.recover(svc2)["replayed"] == 3
+    w2.close()
+
+
+def test_replay_scoped_dedup_on_live_service(tmp_path):
+    """After recovery replays a report, the client's retry of the
+    same blob acks idempotently without double-buffering — and the
+    dedup set is REPLAY-scoped: a service that never recovered keeps
+    admitting identical blobs (the loadgen reuses its pool)."""
+    configure_registry()
+    (svc, m) = make_service()
+    blob = loadgen_mod.build_blob_pool(m, CTX, 1, BITS, replay=1)[0]
+    # Never-recovered service: identical blobs are both admitted.
+    assert svc.submit("count", blob)[0] == "admitted"
+    assert svc.submit("count", blob)[0] == "admitted"
+    c = svc.metrics()["tenants"]["count"]["counters"]
+    assert c["admitted"] == 2
+
+    (svc2, _m) = make_service()
+    d = str(tmp_path / "wal")
+    w = AdmissionWal(d, config=ALWAYS, fresh=True)
+    w.append_report("count", blob)
+    w.close()
+    w2 = AdmissionWal(d, config=ALWAYS)
+    assert w2.recover(svc2)["replayed"] == 1
+    w2.close()
+    # The retry of the replayed blob dedups; admitted stays 1.
+    (status, detail) = svc2.submit("count", blob)
+    assert (status, detail) == ("admitted", "duplicate")
+    assert svc2.metrics()["tenants"]["count"]["counters"][
+        "admitted"] == 1

@@ -52,6 +52,15 @@ this pass keeps out of the tree:
          bounded exit.  Loops bounded by construction carry an
          allow naming the bound.
 
+  RB006  a publish-by-rename without durability (ISSUE 18): an
+         `os.replace` / `os.rename` in a scope that never calls any
+         fsync (`os.fsync`, `fsync_dir`, ...).  Rename makes a file
+         *visible* atomically but not *durable* — after a crash the
+         final name can hold an empty or torn file, exactly the
+         state the WAL recovery and `--resume` snapshot loading must
+         never be handed.  The sanctioned idiom is tmp-write →
+         flush+fsync(file) → os.replace → fsync(directory).
+
 Intentional exceptions are suppressed inline with a justified
 `# mastic-allow: RB00x — reason`, same as every other pass.
 """
@@ -72,6 +81,8 @@ RULES = {
     "RB004": "unbounded queue/list growth without a capacity bound "
              "or shed policy",
     "RB005": "deadline-less while loop in service scheduler code",
+    "RB006": "os.replace/os.rename without an fsync in scope — "
+             "rename publishes, fsync makes durable",
 }
 
 SCOPE_PREFIXES = ("mastic_tpu/drivers/", "mastic_tpu/net/")
@@ -320,6 +331,42 @@ def _check_rb005(info, findings) -> None:
                     f"or allow naming the structural bound"))
 
 
+_RENAME_FNS = {"replace", "rename"}
+
+
+def _call_name(node: ast.Call) -> str:
+    f = node.func
+    return (f.attr if isinstance(f, ast.Attribute)
+            else f.id if isinstance(f, ast.Name) else "")
+
+
+def _check_rb006(info, findings) -> None:
+    """Publish-by-rename without durability: flag `os.replace` /
+    `os.rename` in any scope that never calls an fsync — `os.fsync`,
+    the WAL's `fsync_dir`, or any wrapper whose name carries
+    "fsync".  Scope-level, like RB001's timeout arming: the fsync
+    that makes the tmp file durable must live next to the rename
+    that publishes it, not in some caller the analyzer can't see."""
+    for scope in _scopes(info.tree):
+        nodes = [n for n in _scope_statements(scope)
+                 if isinstance(n, ast.Call)]
+        if any("fsync" in _call_name(n) for n in nodes):
+            continue
+        for node in nodes:
+            if not isinstance(node.func, ast.Attribute) \
+                    or node.func.attr not in _RENAME_FNS \
+                    or root_name(node.func.value) != "os":
+                continue
+            findings.append(Finding(
+                "RB006", info.rel, node.lineno,
+                f"os.{node.func.attr}() with no fsync in this scope "
+                f"— rename publishes the name atomically but not "
+                f"durably; a crash can leave an empty or torn file "
+                f"under the final name.  fsync the tmp file before "
+                f"the rename (and the directory after), or allow "
+                f"naming the durability story"))
+
+
 def check(info) -> list:
     findings: list = []
     _check_rb001(info, findings)
@@ -327,6 +374,7 @@ def check(info) -> list:
     _check_rb003(info, findings)
     _check_rb004(info, findings)
     _check_rb005(info, findings)
+    _check_rb006(info, findings)
     seen = set()
     out = []
     for f in findings:

@@ -120,6 +120,14 @@ run parties-tcp python tools/serve.py --chaos-drill 7 --chaos-seeds 3
 run chaos-soak python tools/serve.py --chaos-drill 100 \
     --chaos-seeds 8
 
+# 6g. Durable admission on the chip host (ISSUE 18): the WAL drill's
+# disk-fault campaign — kill-9 at every WAL checkpoint plus eight
+# seeded kill/short_write/enospc schedules — with chip-speed epoch
+# compute; every resumed run stamps replayed-record counts and
+# recovery wall time, and must end bit-identical with exactly the
+# clean run's admissions (USAGE.md "Durability", PERF.md §14).
+run wal-soak python tools/serve.py --wal-drill 100 --wal-seeds 8
+
 # 6c. On-chip AOT bake + trace-free load cycle (ISSUE 9,
 # drivers/artifacts.py): bake the cold-start family on the chip,
 # then bench.py --cold-start reuses the store (MASTIC_ARTIFACT_DIR

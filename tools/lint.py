@@ -466,6 +466,7 @@ _SHED_SINKS = {"bump_shed", "count_front_shed", "shed",
                "shed_external"}
 _REASON_ROW_RE = re.compile(r"^\|\s*`([a-z0-9]+(?:-[a-z0-9]+)+)`")
 _REASON_SECTIONS = ("## Collector service", "## Network front",
+                    "## Durability",
                     "## Transport security")
 
 

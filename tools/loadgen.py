@@ -474,10 +474,252 @@ def run_upload_drill(args, tmp: str) -> dict:
     if admitted != 6:
         fail(f"kill9: {admitted} reports admitted over both lives, "
              f"wanted exactly 6 (lost or duplicated)")
+    # Time-to-recover is a first-class metric (ISSUE 18): the resumed
+    # collector stamps its WAL recovery attribution and the drill
+    # carries it into the BENCH_*/PERF record.
+    wal_info = resumed.get("wal") or {}
+    if "recovery_wall_ms" not in wal_info:
+        fail(f"kill9: resumed run did not stamp WAL recovery "
+             f"attribution: {wal_info}")
     return {"clean_result": clean["results"]["count"],
             "resumed_result": resumed["results"]["count"],
             "admitted_total": admitted,
+            "recovery_wall_ms": wal_info["recovery_wall_ms"],
+            "replayed_records": wal_info.get("replayed_records", 0),
             "bit_identical": True}
+
+
+class _SnapshotSettler:
+    """The r16 durability discipline as a persist callback, for the
+    §14 baseline: an ack is released only after a FULL service
+    snapshot (serialize + fsync + rename + fsync(dir)) covering it
+    lands.  Generously batched — one settle releases every waiter
+    that arrived while the previous snapshot was writing, the exact
+    analogue of the WAL's group commit — so the measured gap is the
+    cost of serializing O(state) per settle vs appending O(record)."""
+
+    def __init__(self, svc, path: str):
+        import threading
+
+        self.svc = svc
+        self.path = path
+        self.snapshot_bytes = 0
+        self.settles = 0
+        self._mu = threading.Lock()
+        self._waiters: list = []
+        self._closed = False
+        self._thread = threading.Thread(target=self._loop,
+                                        daemon=True,
+                                        name="snapshot-settler")
+        self._thread.start()
+
+    def persist(self, tenant: str, body: bytes) -> None:
+        import threading
+
+        ev = threading.Event()
+        with self._mu:
+            self._waiters.append(ev)
+        if not ev.wait(60.0):
+            raise RuntimeError("snapshot settle timed out")
+
+    def _loop(self) -> None:
+        from mastic_tpu.drivers.wal import fsync_dir
+
+        while True:
+            with self._mu:
+                if self._closed:
+                    for ev in self._waiters:
+                        ev.set()
+                    return
+                batch = self._waiters
+                self._waiters = []
+            if not batch:
+                time.sleep(0.0005)
+                continue
+            data = self.svc.to_bytes()
+            tmp = self.path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+            fsync_dir(os.path.dirname(os.path.abspath(self.path)))
+            self.snapshot_bytes = len(data)
+            self.settles += 1
+            for ev in batch:
+                ev.set()
+
+    def close(self) -> None:
+        with self._mu:
+            self._closed = True
+        self._thread.join(timeout=10.0)
+
+
+def run_wal_bench(args) -> None:
+    """The measured cost of durability (ISSUE 18, PERF.md §14): the
+    SAME HTTP admission path — real sockets, worker clients, valid
+    blobs — under three persistence disciplines:
+
+      1. ``snapshot_before_ack`` — the r16 baseline (a full durable
+         service snapshot covers every ack), batched as generously
+         as the WAL's group commit;
+      2. ``wal_always`` — one fsync per record, the latency floor;
+      3. ``wal_group``  — the shipped default (``group:2`` ms).
+
+    Prints one JSON line (committed as BENCH_WAL.json) with the
+    admission rate per mode, the WAL modes' p50/p99 fsync-wait, and
+    the group-vs-snapshot speedup; FAILS unless group commit admits
+    at least 5x the snapshot-before-ack rate."""
+    import shutil
+    import tempfile
+    import threading
+    from http.client import HTTPConnection
+
+    from mastic_tpu.drivers.wal import AdmissionWal, WalConfig
+    from mastic_tpu.net.admission import NetConfig
+    from mastic_tpu.net.ingest import MEDIA_TYPE, UploadFront
+
+    t_start = time.time()
+    reports = args.wal_reports
+    workers = args.wal_workers
+    (_svc0, tenants) = build_service(bits=2, max_buffered=10 ** 6,
+                                     ingest_threads=0,
+                                     ingest_queue=256)
+    pool = build_pools(tenants, 2, pool=64,
+                       replay=args.replay)["count"]["valid"]
+    tmp = tempfile.mkdtemp(prefix="mastic-wal-bench-")
+
+    def drive(front) -> tuple:
+        """`reports` PUTs over `workers` keep-alive connections;
+        returns (acked, wall_s).  Any non-2xx fails the bench — this
+        path must admit everything, or the rates compare nothing."""
+        next_i = [0]
+        mu = threading.Lock()
+        acked = [0]
+        errors: list = []
+
+        def worker() -> None:
+            conn = HTTPConnection("127.0.0.1", front.port,
+                                  timeout=30)
+            try:
+                while True:
+                    with mu:
+                        i = next_i[0]
+                        if i >= reports or errors:
+                            return
+                        next_i[0] = i + 1
+                    blob = pool[i % len(pool)]
+                    # A dropped keep-alive or accept-backlog reset is
+                    # the client's to retry (the un-acked upload is
+                    # at-least-once by contract); only a persistent
+                    # transport failure fails the bench.
+                    status = None
+                    for attempt in range(3):
+                        try:
+                            conn.request(
+                                "PUT", "/v1/tenants/count/reports",
+                                body=blob,
+                                headers={"Content-Type": MEDIA_TYPE})
+                            resp = conn.getresponse()
+                            resp.read()
+                            status = resp.status
+                            break
+                        except OSError:
+                            conn.close()
+                            time.sleep(0.01 * (attempt + 1))
+                            conn = HTTPConnection(
+                                "127.0.0.1", front.port, timeout=30)
+                    if status is None:
+                        errors.append(f"transport error on {i}")
+                        return
+                    if status not in (201, 202):
+                        errors.append(f"upload {i}: {status}")
+                        return
+                    with mu:
+                        acked[0] += 1
+            finally:
+                conn.close()
+
+        threads = [threading.Thread(target=worker)
+                   for _ in range(workers)]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.monotonic() - t0
+        if errors:
+            fail(f"wal bench: {errors[0]}")
+        return (acked[0], wall)
+
+    def fresh_front(persist):
+        (svc, _t) = build_service(bits=2, max_buffered=10 ** 6,
+                                  ingest_threads=0, ingest_queue=256)
+        front = UploadFront(
+            svc, config=NetConfig(max_connections=256,
+                                  trust_forwarded=True),
+            persist=persist).start()
+        return (svc, front)
+
+    modes = {}
+
+    # 1. Snapshot-before-ack (the r16 discipline).
+    (svc, front) = fresh_front(None)
+    settler = _SnapshotSettler(svc, os.path.join(tmp, "base.snap"))
+    front._persist = settler.persist
+    (acked, wall) = drive(front)
+    front.stop()
+    settler.close()
+    if acked != reports:
+        fail(f"wal bench snapshot: {acked}/{reports} acked")
+    modes["snapshot_before_ack"] = {
+        "acked": acked, "wall_s": round(wall, 3),
+        "rate_rps": round(acked / wall, 1),
+        "settles": settler.settles,
+        "snapshot_bytes_final": settler.snapshot_bytes}
+
+    # 2 + 3. The WAL disciplines.
+    for (key, cfg) in (
+            ("wal_always", WalConfig(fsync="always")),
+            ("wal_group", WalConfig(fsync="group", group_ms=2.0))):
+        wal = AdmissionWal(os.path.join(tmp, key), config=cfg)
+        (svc, front) = fresh_front(wal.append_report)
+        (acked, wall) = drive(front)
+        front.stop()
+        stats = wal.stats()
+        wal.close()
+        if acked != reports:
+            fail(f"wal bench {key}: {acked}/{reports} acked")
+        modes[key] = {
+            "acked": acked, "wall_s": round(wall, 3),
+            "rate_rps": round(acked / wall, 1),
+            "fsync": cfg.fsync,
+            "fsync_wait_ms_p50": round(
+                stats["fsync_wait_ms_p50"], 3),
+            "fsync_wait_ms_p99": round(
+                stats["fsync_wait_ms_p99"], 3),
+            "appends": stats["appends"],
+            "segments": stats["segments"]}
+        if key == "wal_group":
+            modes[key]["group_ms"] = 2.0
+
+    shutil.rmtree(tmp, ignore_errors=True)
+    speedup = (modes["wal_group"]["rate_rps"]
+               / modes["snapshot_before_ack"]["rate_rps"])
+    out = {"mode": "wal-bench", "reports": reports,
+           "workers": workers, "modes": modes,
+           "speedup_group_vs_snapshot": round(speedup, 2),
+           "wall_seconds": round(time.time() - t_start, 1),
+           "ok": True}
+    line = json.dumps(out)
+    print(line, flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    if speedup < 5.0:
+        fail(f"wal bench: group-commit admission rate is only "
+             f"{speedup:.2f}x the snapshot-before-ack baseline "
+             f"(acceptance: >= 5x)")
 
 
 def run_smoke(args) -> None:
@@ -600,11 +842,26 @@ def main() -> None:
                              "run must hold")
     parser.add_argument("--seed", dest="replay", type=int,
                         default=0, help="deterministic replay index")
+    parser.add_argument("--wal-bench", action="store_true",
+                        help="measure the durability disciplines "
+                             "head to head (snapshot-before-ack vs "
+                             "WAL always vs WAL group commit) over "
+                             "the real HTTP path; PERF.md §14")
+    parser.add_argument("--wal-reports", type=int, default=20000,
+                        help="uploads per --wal-bench mode — the "
+                             "baseline's per-settle cost is O(state), "
+                             "so the measured gap grows with this "
+                             "(PERF.md §14 quotes the curve)")
+    parser.add_argument("--wal-workers", type=int, default=32,
+                        help="concurrent clients per --wal-bench "
+                             "mode")
     parser.add_argument("--out", type=str, default=None)
     args = parser.parse_args()
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    if args.smoke:
+    if args.wal_bench:
+        run_wal_bench(args)
+    elif args.smoke:
         run_smoke(args)
     else:
         run_load(args)

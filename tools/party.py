@@ -53,10 +53,17 @@ def parse_hostport(text: str) -> tuple:
 
 
 def _write_port_file(path: str, ports: dict) -> None:
+    # fsync-then-rename (RB006): a reader polling for this file must
+    # never observe a torn JSON body under the final name.
+    from mastic_tpu.drivers.wal import fsync_dir
+
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(ports, f)
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)
+    fsync_dir(os.path.dirname(os.path.abspath(path)))
 
 
 def serve(args) -> int:

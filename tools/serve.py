@@ -52,6 +52,7 @@ a JSONL span trace of every epoch/round/chunk (USAGE.md
 """
 
 import argparse
+import hashlib
 import json
 import os
 import sys
@@ -183,7 +184,7 @@ def check_status_endpoints(status) -> None:
         fail("/varz service snapshot has no tenants")
 
 
-def run_upload_window(args, svc, status):
+def run_upload_window(args, svc, status, wal=None):
     """The HTTP-ingest window (ISSUE 11, `mastic_tpu/net/ingest.py`):
     serve the DAP-shaped upload endpoint for `--upload-window`
     seconds — or until a client POSTs the admin drain control — then
@@ -194,69 +195,85 @@ def run_upload_window(args, svc, status):
     r15 thread-safe seam) and ENQUEUE — epoch cuts and snapshots
     execute here, on this thread, which owns the whole scheduler
     plane (the CC001 pass holds the tree to exactly this split).
-    With `--snapshot` an admitted upload enqueues a durability
-    ticket and its 2xx WAITS until this loop has written the
-    snapshot, so a client holding an ack can never lose that report
-    to a kill -9; an un-acked upload is the client's to retry (the
-    DAP upload contract) — `tools/loadgen.py --smoke`'s mid-upload
-    crash drill drives exactly this pair via `--resume`."""
-    import queue as queue_mod
-    import threading
-
+    Durability (ISSUE 18): with `--snapshot` a WAL sits under
+    admission — each handler's 2xx waits only for its record's
+    (group-committed) fsync, not a full snapshot, so a client holding
+    an ack can never lose that report to a kill -9; an un-acked
+    upload is the client's to retry (the DAP upload contract).  The
+    snapshot-before-ack ticket loop this replaces survives only as
+    the compaction trigger: this thread snapshots PERIODICALLY
+    (`--snapshot-every`) and truncates the WAL segments the snapshot
+    covers — `tools/loadgen.py --smoke`'s mid-upload crash drill and
+    `--wal-drill` drive the kill/--resume pair."""
     from mastic_tpu.drivers.session import Deadline
     from mastic_tpu.net.ingest import UploadFront
-
-    # Durability tickets: bounded, so a hammered endpoint blocks its
-    # handlers at 64 in-flight acks instead of growing.
-    tickets: queue_mod.Queue = queue_mod.Queue(maxsize=64)
-
-    def on_admitted(tenant):
-        done = threading.Event()
-        tickets.put(done)
-        if not done.wait(timeout=60.0):
-            raise RuntimeError("snapshot ticket timed out — the "
-                               "2xx must not outrun durability")
 
     front = UploadFront(
         svc, port=args.upload_port, admin=True,
         injector=svc.injector,
-        on_admitted=(on_admitted if args.snapshot else None)).start()
+        persist=(wal.append_report if wal is not None
+                 else None)).start()
     if args.port_file:
         tmp = args.port_file + ".tmp"
         with open(tmp, "w") as f:
             json.dump({"upload_port": front.port}, f)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, args.port_file)
+        fsync_dir(os.path.dirname(args.port_file))
 
-    def settle_tickets() -> None:
-        # qsize() is exact here: only this thread pops; a producer
-        # arriving mid-drain settles on the next loop pass.
-        waiting = [tickets.get() for _ in range(tickets.qsize())]
-        if waiting:
-            write_snapshot(svc, args.snapshot)
-            for done in waiting:
-                done.set()
+    def compact() -> None:
+        # Covered-seq FIRST: anything appended while to_bytes runs
+        # is not provably in the snapshot, so it stays replayable.
+        seq = wal.tail_seq()
+        digest = write_snapshot(svc, args.snapshot)
+        wal.mark_covered(seq, digest)
 
+    def cut_epoch(tenant: str) -> None:
+        if wal is not None:
+            # Log the cut before executing it: a crash between the
+            # two replays the same cut over the same reports.
+            wal.append_epoch_cut(tenant)
+        svc.begin_epoch(tenant)
+
+    next_compact = time.monotonic() + args.snapshot_every
     deadline = Deadline(args.upload_window)
     while not deadline.expired():
         drain_now = front.drain_requested.wait(0.02)
-        settle_tickets()
         for tenant in front.pop_epoch_requests():
-            svc.begin_epoch(tenant)
+            cut_epoch(tenant)
+        if wal is not None and time.monotonic() >= next_compact:
+            compact()
+            next_compact = time.monotonic() + args.snapshot_every
         publish_status(status, svc)
         if drain_now:
             break
     front.stop()
-    settle_tickets()
     for tenant in front.pop_epoch_requests():
-        svc.begin_epoch(tenant)
+        cut_epoch(tenant)
     for name in list(svc.tenants):
-        svc.begin_epoch(name)
-    if args.snapshot:
+        cut_epoch(name)
+    if wal is not None:
+        compact()
+    elif args.snapshot:
         write_snapshot(svc, args.snapshot)
     return front.port
 
 
-def write_snapshot(svc, path: str) -> None:
+def fsync_dir(path: str) -> None:
+    from mastic_tpu.drivers import wal as wal_mod
+
+    wal_mod.fsync_dir(path or ".")
+
+
+def write_snapshot(svc, path: str) -> str:
+    """Crash-safe snapshot write — the full tmp → fsync(file) →
+    os.replace → fsync(dir) sequence (RB006's required idiom: rename
+    alone can land with the bytes still in the page cache).  Returns
+    the SHA-256 hexdigest of the snapshot bytes: the WAL's covered
+    marker records it, and recovery re-verifies it before trusting
+    the marker over replay."""
+    data = svc.to_bytes()
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         # mastic-allow: SF004 — the snapshot is the durable
@@ -264,8 +281,12 @@ def write_snapshot(svc, path: str) -> None:
         # (the resumed process re-derives nothing); the trust
         # boundary is filesystem permissions on the operator's
         # --snapshot path, not the codec layer
-        f.write(svc.to_bytes())
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)
+    fsync_dir(os.path.dirname(path))
+    return hashlib.sha256(data).hexdigest()
 
 
 def main() -> None:
@@ -307,6 +328,30 @@ def main() -> None:
                              "(USAGE.md 'Transport security')")
     parser.add_argument("--chaos-seeds", type=int, default=3,
                         help="distinct chaos schedules to run, "
+                             "seeds SEED..SEED+N-1 (default 3)")
+    parser.add_argument("--wal", type=str, default=None,
+                        help="directory of the durable admission WAL "
+                             "(ISSUE 18; default <snapshot>.wal — "
+                             "armed whenever --snapshot and "
+                             "--upload-port are both set; USAGE.md "
+                             "'Durability')")
+    parser.add_argument("--snapshot-every", type=float, default=5.0,
+                        help="seconds between periodic compaction "
+                             "snapshots while the upload window is "
+                             "open (the WAL subsumed per-ack "
+                             "snapshots)")
+    parser.add_argument("--wal-drill", type=int, default=None,
+                        metavar="SEED",
+                        help="the disk-fault leg of the seeded chaos "
+                             "campaign (ISSUE 18): kill -9 at every "
+                             "WAL checkpoint plus randomized kill/"
+                             "torn-tail/ENOSPC schedules over the "
+                             "HTTP ingest path — each must recover "
+                             "bit-identical with zero lost acked "
+                             "reports and zero duplicates (`make "
+                             "wal-smoke`)")
+    parser.add_argument("--wal-seeds", type=int, default=3,
+                        help="randomized WAL fault schedules to run, "
                              "seeds SEED..SEED+N-1 (default 3)")
     parser.add_argument("--status-port", type=int, default=None,
                         help="serve /metrics, /statusz and /varz on "
@@ -387,6 +432,9 @@ def main() -> None:
     if args.chaos_drill is not None:
         run_chaos_drill(args)
         return
+    if args.wal_drill is not None:
+        run_wal_drill(args)
+        return
 
     from mastic_tpu.drivers.service import (CollectorService,
                                             ServiceConfig, TenantSpec)
@@ -420,12 +468,37 @@ def main() -> None:
     config = ServiceConfig.from_env()
     config.page_size = args.page_size
 
+    snap_sha256 = None
     if args.resume:
         with open(args.snapshot, "rb") as f:
-            svc = CollectorService.from_bytes(f.read(), config=config,
-                                              mesh=mesh)
+            snap_bytes = f.read()
+        snap_sha256 = hashlib.sha256(snap_bytes).hexdigest()
+        svc = CollectorService.from_bytes(snap_bytes, config=config,
+                                          mesh=mesh)
     else:
         svc = CollectorService(tenants, config=config, mesh=mesh)
+
+    # The durable admission log (ISSUE 18): armed whenever the HTTP
+    # ingest plane and a snapshot path are both configured.  On
+    # --resume, recovery replays every record the restored snapshot
+    # does not cover (verified by digest) BEFORE the window reopens.
+    wal = None
+    wal_recovery = None
+    if args.upload_port is not None and args.snapshot:
+        from mastic_tpu.drivers.wal import AdmissionWal
+
+        wal = AdmissionWal(args.wal or (args.snapshot + ".wal"),
+                           injector=svc.injector,
+                           fresh=not args.resume)
+        if args.resume:
+            wal_recovery = wal.recover(svc,
+                                       snapshot_sha256=snap_sha256)
+        else:
+            # Seed the compaction baseline: the snapshot file exists
+            # from boot, so a crash at ANY later point resumes from
+            # snapshot + WAL replay, never from nothing.
+            wal.mark_covered(wal.tail_seq(),
+                             write_snapshot(svc, args.snapshot))
     status = start_status(args.status_port)
     publish_status(status, svc)
 
@@ -447,7 +520,7 @@ def main() -> None:
         # HTTP ingest replaces the synthetic admission loop entirely
         # (on --resume too: the reopened window is where a client
         # retries the uploads the crashed process never acked).
-        upload_port = run_upload_window(args, svc, status)
+        upload_port = run_upload_window(args, svc, status, wal=wal)
     elif not args.resume:
         for _ in range(args.epochs):
             reports = build_reports(m_count, b"serve count", rng,
@@ -462,7 +535,10 @@ def main() -> None:
             write_snapshot(svc, args.snapshot)
     drain(svc, snapshot_path=args.snapshot, status=status)
     if args.snapshot:
-        write_snapshot(svc, args.snapshot)
+        digest = write_snapshot(svc, args.snapshot)
+        if wal is not None:
+            wal.mark_covered(wal.tail_seq(), digest)
+            wal.close()
 
     metrics = svc.metrics()
     out = {
@@ -480,11 +556,27 @@ def main() -> None:
         "metrics": metrics,
         "ok": True,
     }
+    if wal is not None:
+        out["wal"] = wal.stats()
+        if wal_recovery is not None:
+            out["wal"]["recovery"] = wal_recovery
+            out["wal"]["replayed_records"] = \
+                wal_recovery["replayed"]
+            out["wal"]["recovery_wall_ms"] = \
+                wal_recovery["recovery_wall_ms"]
     line = json.dumps(out)
     print(line, flush=True)
     if args.out:
         with open(args.out, "w") as f:
             f.write(line + "\n")
+    if os.environ.get("MASTIC_HARD_EXIT"):
+        # Drill children (--wal-drill spawns ~a dozen of these): the
+        # work is done and durably on disk — skip the interpreter's
+        # atexit teardown, where jaxlib's clear_backends segfaults
+        # flakily on CPU and would be misread as a lost-ack failure.
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(0)
 
 
 def run_overlap_drill(args) -> None:
@@ -882,6 +974,302 @@ def run_chaos_drill(args) -> None:
         "tcp_mtls_bit_identical": True,
         "runs": runs,
         "hitters": [[bool(b) for b in p] for p in base[0]],
+        "wall_seconds": round(time.time() - t_start, 1),
+        "ok": True,
+    }
+    line = json.dumps(out)
+    print(line, flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+
+
+def run_wal_drill(args) -> None:
+    """The disk-fault leg of the seeded chaos campaign (ISSUE 18,
+    `make wal-smoke`): drive the HTTP ingest path with the WAL armed
+    and (a) kill -9 at EVERY WAL checkpoint — `wal_append` (before
+    the record's write), `wal_fsync` (written, not yet durable),
+    `wal_ack` (durable, not yet acked) — then (b) `--wal-seeds`
+    randomized schedules drawn from the disk-fault vocabulary
+    (kill-at-checkpoint, short_write torn tail, ENOSPC brownout).
+    Every schedule must end bit-identical to the undisturbed run
+    with EXACTLY the clean run's reports admitted: zero acked-but-
+    lost, zero duplicates.  Recovery must attribute itself (replayed
+    / torn_tail counts and wall time in the resumed child's JSON)."""
+    import random
+    import shutil
+    import subprocess
+    import tempfile
+    from http.client import HTTPConnection
+
+    import numpy as np
+
+    from mastic_tpu.drivers import faults
+    from mastic_tpu.drivers.service import encode_upload
+    from mastic_tpu.mastic import MasticCount
+    from mastic_tpu.net.ingest import MEDIA_TYPE
+
+    t_start = time.time()
+    serve_py = os.path.abspath(__file__)
+    bits = 2
+    m = MasticCount(bits)
+    rng = np.random.default_rng(args.wal_drill)
+    blobs = []
+    for value in [0, 0, 0, 3, 3, 3]:
+        alpha = m.vidpf.test_index_from_int(value, bits)
+        nonce = bytes(rng.integers(0, 256, m.NONCE_SIZE,
+                                   dtype="uint8"))
+        rand = bytes(rng.integers(0, 256, m.RAND_SIZE,
+                                  dtype="uint8"))
+        (ps, shares) = m.shard(b"serve count", (alpha, True), nonce,
+                               rand)
+        blobs.append(encode_upload(m, (nonce, ps, shares)))
+    tmp = tempfile.mkdtemp(prefix="mastic-wal-drill-")
+
+    def spawn(tag, fault=None, resume=False, snap_tag=None):
+        pf = os.path.join(tmp, f"{tag}.port")
+        snap = os.path.join(tmp, f"{snap_tag or tag}.snap")
+        env = {**os.environ, "JAX_PLATFORMS": "cpu",
+               "PYTHONFAULTHANDLER": "1",
+               "MASTIC_HARD_EXIT": "1"}
+        env.pop("MASTIC_FAULTS", None)
+        env.pop("MASTIC_NET_SHAPE", None)
+        # The campaign spawns ~a dozen collector children that all
+        # lower the same tiny programs — share one persistent compile
+        # cache so only the first child pays the XLA lowering.  A
+        # child running under a fault (it may die by kill-9) gets a
+        # throwaway COPY of the warm cache instead: jax's cache
+        # writes are not atomic, so a kill mid-write plants a torn
+        # entry that heap-corrupts the next reader.
+        shared_cache = os.path.join(tmp, "jaxcache")
+        if fault is None:
+            cache = shared_cache
+        else:
+            cache = os.path.join(tmp, f"jaxcache-{tag}")
+            if os.path.isdir(shared_cache) \
+                    and not os.path.isdir(cache):
+                shutil.copytree(shared_cache, cache)
+        os.makedirs(cache, exist_ok=True)
+        env["JAX_COMPILATION_CACHE_DIR"] = cache
+        env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+                       "0.5")
+        if fault is not None:
+            env["MASTIC_FAULTS"] = fault
+        cmd = [sys.executable, serve_py, "--reports", "6", "--bits",
+               str(bits), "--page-size", "2", "--upload-port", "0",
+               "--upload-window", "120", "--port-file", pf,
+               "--snapshot", snap]
+        if resume:
+            cmd.append("--resume")
+        proc = subprocess.Popen(cmd, env=env, text=True,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE)
+        return (proc, pf, snap)
+
+    def wait_port(path, deadline_s=120.0):
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline:
+            try:
+                with open(path) as f:
+                    return json.load(f)["upload_port"]
+            except (OSError, ValueError, KeyError):
+                time.sleep(0.05)
+        fail(f"wal drill: no port file at {path}")
+
+    def put_one(port, blob):
+        """One PUT; returns (status_code, retry_after) — status None
+        when the collector died mid-request."""
+        try:
+            conn = HTTPConnection("127.0.0.1", port, timeout=30)
+            conn.request("PUT", "/v1/tenants/count/reports",
+                         body=blob,
+                         headers={"Content-Type": MEDIA_TYPE})
+            resp = conn.getresponse()
+            resp.read()
+            retry_after = resp.getheader("Retry-After")
+            conn.close()
+            return (resp.status, retry_after)
+        except OSError:
+            return (None, None)
+
+    def put_all(port, send, brownouts=None):
+        """PUT each (index, blob); 503s honor Retry-After and retry
+        in place (the brownout contract); a dead socket stops the
+        loop — the tail is the client's to retry after resume."""
+        acked = []
+        for (i, blob) in send:
+            while True:
+                (code, retry_after) = put_one(port, blob)
+                if code == 503:
+                    if brownouts is not None:
+                        brownouts.append(i)
+                        if retry_after is None:
+                            fail(f"wal drill: 503 without "
+                                 f"Retry-After on upload {i}")
+                    time.sleep(min(float(retry_after or 1), 2.0))
+                    continue
+                break
+            if code in (201, 202):
+                acked.append(i)
+            elif code is None:
+                break
+            else:
+                fail(f"wal drill: upload {i} got {code}")
+        return acked
+
+    def cut_and_drain(port):
+        conn = HTTPConnection("127.0.0.1", port, timeout=30)
+        conn.request("POST", "/v1/tenants/count/epoch",
+                     headers={"Content-Length": "0"})
+        conn.getresponse().read()
+        conn.request("POST", "/v1/admin/drain",
+                     headers={"Content-Length": "0"})
+        conn.getresponse().read()
+        conn.close()
+
+    def finish(proc, tag, expect_rc=0):
+        (out, err) = proc.communicate(timeout=1500)
+        if proc.returncode != expect_rc:
+            fail(f"wal drill {tag}: rc={proc.returncode} (wanted "
+             f"{expect_rc}): {err[-1500:]}")
+        if expect_rc != 0:
+            return {}
+        return json.loads(out.strip().splitlines()[-1])
+
+    def admitted_total(result):
+        return result["metrics"]["tenants"]["count"]["counters"][
+            "admitted"]
+
+    def run_schedule(tag, fault, lethal):
+        """One campaign entry: run under `fault`; if `lethal`, the
+        child must die with the kill exit code and a resumed child
+        finishes the collection.  Returns the final run's JSON plus
+        the acked bookkeeping."""
+        (proc, pf, snap) = spawn(tag, fault=fault)
+        port = wait_port(pf)
+        brownouts = []
+        acked = put_all(port, list(enumerate(blobs)),
+                        brownouts=brownouts)
+        if not lethal:
+            if len(acked) != 6:
+                proc.kill()
+                fail(f"wal drill {tag}: acked {acked}, wanted all 6")
+            cut_and_drain(port)
+            return (finish(proc, tag), acked, brownouts, None)
+        finish(proc, tag, expect_rc=faults.KILL_EXIT_CODE)
+        if os.environ.get("MASTIC_WAL_DRILL_KEEP"):
+            pre = os.path.join(tmp, f"{tag}.pre-resume")
+            os.makedirs(pre, exist_ok=True)
+            shutil.copy(os.path.join(tmp, f"{tag}.snap"), pre)
+            shutil.copytree(os.path.join(tmp, f"{tag}.snap.wal"),
+                            os.path.join(pre, f"{tag}.snap.wal"),
+                            dirs_exist_ok=True)
+        (proc, pf2, _s) = spawn(f"{tag}-resumed", resume=True,
+                                snap_tag=tag)
+        port = wait_port(pf2)
+        retries = [(i, blobs[i]) for i in range(6) if i not in acked]
+        re_acked = put_all(port, retries)
+        if len(re_acked) != len(retries):
+            proc.kill()
+            fail(f"wal drill {tag}: retries {re_acked} of "
+                 f"{[i for (i, _b) in retries]}")
+        cut_and_drain(port)
+        return (finish(proc, f"{tag}-resumed"), acked + re_acked,
+                brownouts, None)
+
+    # Undisturbed baseline.
+    (clean, _acked, _b, _r) = run_schedule("clean", None, False)
+    clean_admitted = admitted_total(clean)
+
+    runs = []
+    # (a) kill -9 at every WAL checkpoint, deterministically.
+    for step in ("wal_append", "wal_fsync", "wal_ack"):
+        tag = f"kill-{step}"
+        fault = f"kill:party=collector:step={step}:nth=4"
+        (result, acked, _b, _r) = run_schedule(tag, fault, True)
+        if result["results"]["count"] != clean["results"]["count"]:
+            print(json.dumps(result), file=sys.stderr, flush=True)
+            fail(f"wal drill {tag}: results diverge\n"
+                 f"  clean: {clean['results']['count']}\n"
+                 f"  {tag}: {result['results']['count']}")
+        if admitted_total(result) != clean_admitted:
+            fail(f"wal drill {tag}: {admitted_total(result)} "
+                 f"admitted, wanted {clean_admitted} (lost or "
+                 f"duplicated)")
+        wal_info = result.get("wal") or {}
+        if "recovery_wall_ms" not in wal_info:
+            fail(f"wal drill {tag}: resumed child did not stamp "
+                 f"recovery attribution: {wal_info}")
+        runs.append({"schedule": fault,
+                     "replayed": wal_info.get("replayed_records"),
+                     "recovery_wall_ms":
+                         wal_info.get("recovery_wall_ms")})
+
+    # (b) seeded randomized disk-fault schedules.
+    seeds = list(range(args.wal_drill,
+                       args.wal_drill + args.wal_seeds))
+    for seed in seeds:
+        r = random.Random(seed)
+        kind = r.choice(["kill", "kill", "short_write", "enospc"])
+        nth = r.randint(2, 5)
+        if kind == "kill":
+            step = r.choice(["wal_append", "wal_fsync", "wal_ack"])
+            fault = f"kill:party=collector:step={step}:nth={nth}"
+            lethal = True
+        elif kind == "short_write":
+            cut = r.randint(1, 24)
+            fault = (f"short_write:party=collector:step=wal_append"
+                     f":nth={nth}:cut={cut}")
+            lethal = True
+        else:
+            fault = f"enospc:party=collector:step=wal_append:nth={nth}"
+            lethal = False
+        (result, acked, brownouts, _r2) = run_schedule(
+            f"seed-{seed}", fault, lethal)
+        if result["results"]["count"] != clean["results"]["count"]:
+            fail(f"wal drill seed {seed}: results diverge under "
+                 f"[{fault}]\n"
+                 f"  clean: {clean['results']['count']}\n"
+                 f"  seed-{seed}: {result['results']['count']}")
+        if admitted_total(result) != clean_admitted:
+            fail(f"wal drill seed {seed}: "
+                 f"{admitted_total(result)} admitted, wanted "
+                 f"{clean_admitted} under [{fault}] (lost or "
+                 f"duplicated)")
+        rec = {"seed": seed, "schedule": fault}
+        if kind == "enospc":
+            if not brownouts:
+                fail(f"wal drill seed {seed}: injected ENOSPC but "
+                     f"no 503 brownout was observed")
+            shed = result["metrics"]["tenants"]["count"][
+                "counters"]["shed_reasons"]
+            if not shed.get("wal-full"):
+                fail(f"wal drill seed {seed}: brownout not "
+                     f"attributed as wal-full: {shed}")
+            rec["brownouts"] = len(brownouts)
+        if kind == "short_write":
+            torn = (result.get("wal") or {}).get(
+                "recovery", {}).get("torn_tail", 0)
+            if not torn:
+                fail(f"wal drill seed {seed}: injected torn tail "
+                     f"was not counted at recovery: "
+                     f"{result.get('wal')}")
+            rec["torn_tail"] = torn
+        if lethal:
+            rec["recovery_wall_ms"] = (result.get("wal") or {}).get(
+                "recovery_wall_ms")
+        runs.append(rec)
+        print(f"wal drill: seed {seed} ok — [{fault}]",
+              file=sys.stderr, flush=True)
+
+    shutil.rmtree(tmp, ignore_errors=True)
+    out = {
+        "mode": "wal-drill",
+        "seeds": seeds,
+        "checkpoints": ["wal_append", "wal_fsync", "wal_ack"],
+        "admitted": clean_admitted,
+        "bit_identical": True,
+        "runs": runs,
         "wall_seconds": round(time.time() - t_start, 1),
         "ok": True,
     }
